@@ -1,0 +1,25 @@
+"""dwpa_tpu — a TPU-native distributed WPA-PSK audit framework.
+
+A from-scratch reimplementation of the capabilities of `dwpa`
+(reference: DarioAlejandroW/dwpa), replacing the hashcat/John GPU compute
+path with JAX/XLA kernels designed for TPU hardware:
+
+- ``dwpa_tpu.ops``      — uint32-lane crypto primitives (SHA-1, MD5,
+  SHA-256, AES-128-CMAC, HMAC, PBKDF2) written as batched JAX ops.
+- ``dwpa_tpu.models``   — hash-mode engines; ``m22000`` (WPA PMKID/EAPOL)
+  is the flagship: PBKDF2->PMK -> PMKID-HMAC / PRF+MIC verification with
+  nonce-error-correction, one jitted step over a candidate batch.
+- ``dwpa_tpu.parallel`` — device-mesh data-parallel sharding of the
+  candidate axis (jax.sharding / shard_map).
+- ``dwpa_tpu.oracle``   — pure-Python (hashlib) oracle with the exact
+  semantics of the reference server verifier (web/common.php:157-307),
+  used for differential tests and host-side wide-NC re-verification.
+- ``dwpa_tpu.rules``    — hashcat-rule-subset candidate mangler.
+- ``dwpa_tpu.gen``      — candidate generators (dict streams, masks,
+  IMEI/PSK pattern generators).
+- ``dwpa_tpu.client``   — dwpa get_work/put_work protocol client.
+- ``dwpa_tpu.server``   — work server (scheduler, ingestion, verification,
+  maintenance) re-implemented on sqlite.
+"""
+
+__version__ = "0.1.0"

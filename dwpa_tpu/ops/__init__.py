@@ -1,0 +1,1 @@
+from . import aes, common, hmac, md5, sha1, sha256  # noqa: F401

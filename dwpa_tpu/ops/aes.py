@@ -1,0 +1,150 @@
+"""AES-128 and AES-128-CMAC (OMAC1) as batched JAX ops.
+
+Needed only for the WPA2 802.11w keyver=3 MIC (AES-128-CMAC over the EAPOL
+frame, reference semantics: web/common.php:272 / omac1_aes_128 at
+web/common.php:56-112).  keyver=3 nets are rare, so this path favours
+clarity over raw speed: the state is 16 per-byte uint32 arrays and SubBytes
+is a 256-entry ``jnp.take`` (TPU handles the gather; the cost is dwarfed by
+the PBKDF2 loop that precedes it).
+
+The S-box is generated from the GF(2^8) definition at import time rather
+than transcribed, and checked by FIPS-197 test vectors in the test suite.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from .common import u32
+
+
+def _gf_mul(a: int, b: int) -> int:
+    p = 0
+    for _ in range(8):
+        if b & 1:
+            p ^= a
+        hi = a & 0x80
+        a = (a << 1) & 0xFF
+        if hi:
+            a ^= 0x1B
+        b >>= 1
+    return p
+
+
+def _make_sbox() -> np.ndarray:
+    # multiplicative inverse table via exp/log in GF(2^8), generator 3
+    exp = [0] * 510
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x = _gf_mul(x, 3)
+    for i in range(255, 510):
+        exp[i] = exp[i - 255]
+    sbox = np.zeros(256, dtype=np.uint32)
+    for v in range(256):
+        inv = 0 if v == 0 else exp[255 - log[v]]
+        s = inv
+        for shift in (1, 2, 3, 4):
+            s ^= ((inv << shift) | (inv >> (8 - shift))) & 0xFF
+        sbox[v] = s ^ 0x63
+    return sbox
+
+
+SBOX = _make_sbox()
+RCON = [1, 2, 4, 8, 16, 32, 64, 128, 27, 54]
+_SBOX_DEV = None
+
+
+def _sbox_dev():
+    global _SBOX_DEV
+    if _SBOX_DEV is None:
+        _SBOX_DEV = jnp.asarray(SBOX)
+    return _SBOX_DEV
+
+
+def _sub(byte_arr):
+    return jnp.take(_sbox_dev(), byte_arr.astype(jnp.int32))
+
+
+def _xtime(b):
+    return ((b << 1) ^ ((b >> 7) * u32(0x1B))) & u32(0xFF)
+
+
+def aes128_expand_key(key16):
+    """key16: list of 16 uint32 byte-value arrays -> list of 11 round keys."""
+    rk = [list(key16)]
+    for r in range(10):
+        prev = rk[-1]
+        t = [_sub(prev[13]), _sub(prev[14]), _sub(prev[15]), _sub(prev[12])]
+        t[0] = t[0] ^ u32(RCON[r])
+        nk = []
+        for c in range(4):
+            for row in range(4):
+                t[row] = u32(prev[4 * c + row]) ^ t[row]
+            nk.extend(t)
+            t = list(nk[-4:])
+        rk.append(nk)
+    return rk
+
+
+def aes128_encrypt_block(round_keys, block16):
+    """Encrypt one 16-byte block (per-byte uint32 arrays, index = byte pos).
+
+    Byte order follows FIPS-197: block16[i] is byte i of the input, state
+    column c is bytes 4c..4c+3.
+    """
+    s = [u32(block16[i]) ^ u32(round_keys[0][i]) for i in range(16)]
+    for r in range(1, 11):
+        s = [_sub(b) for b in s]
+        # ShiftRows: state[row + 4c] <- state[row + 4((c + row) % 4)]
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if r < 10:
+            ns = []
+            for c in range(4):
+                a0, a1, a2, a3 = s[4 * c : 4 * c + 4]
+                x0, x1, x2, x3 = _xtime(a0), _xtime(a1), _xtime(a2), _xtime(a3)
+                ns.extend(
+                    [
+                        x0 ^ x1 ^ a1 ^ a2 ^ a3,
+                        a0 ^ x1 ^ x2 ^ a2 ^ a3,
+                        a0 ^ a1 ^ x2 ^ x3 ^ a3,
+                        x0 ^ a0 ^ a1 ^ a2 ^ x3,
+                    ]
+                )
+            s = ns
+        s = [s[i] ^ u32(round_keys[r][i]) for i in range(16)]
+    return s
+
+
+def _dbl(b16):
+    """GF(2^128) doubling for CMAC subkeys (left shift 1, xor 0x87)."""
+    out = []
+    for i in range(15):
+        out.append(((b16[i] << 1) | (b16[i + 1] >> 7)) & u32(0xFF))
+    out.append(((b16[15] << 1) & u32(0xFF)) ^ ((b16[0] >> 7) * u32(0x87)))
+    return out
+
+
+def aes128_cmac(key16, msg_blocks, last_block, last_complete):
+    """AES-128-CMAC (OMAC1, RFC 4493).
+
+    ``key16``: 16 uint32 byte arrays (the per-candidate KCK).
+    ``msg_blocks``: list of full 16-byte blocks *before* the last block
+    (each a list of 16 uint32 words/ints).
+    ``last_block``: the final block, already 10*-padded if incomplete.
+    ``last_complete``: static bool — selects the K1/K2 subkey.
+
+    Returns 16 uint32 byte arrays (the MAC).
+    """
+    rks = aes128_expand_key(key16)
+    zero = [u32(0)] * 16
+    l = aes128_encrypt_block(rks, zero)
+    k1 = _dbl(l)
+    sub = k1 if last_complete else _dbl(k1)
+
+    c = [u32(0)] * 16
+    for blk in msg_blocks:
+        c = aes128_encrypt_block(rks, [u32(blk[i]) ^ c[i] for i in range(16)])
+    final = [u32(last_block[i]) ^ sub[i] ^ c[i] for i in range(16)]
+    return aes128_encrypt_block(rks, final)

@@ -1,0 +1,34 @@
+"""Shared helpers for the uint32-lane crypto ops.
+
+Design notes (TPU-first):
+
+Every hash primitive here operates on *batches* laid out as Python lists of
+``uint32`` arrays — one array per 32-bit message/state word, each array
+holding that word for the whole batch.  Elementwise uint32 adds/xors/rotates
+over a batch axis map 1:1 onto the TPU VPU's (8, 128) vector lanes, and the
+fully unrolled round structure gives XLA a straight-line dependency chain it
+can software-pipeline.  There are no gathers, no dynamic shapes, and no
+data-dependent control flow in any compression function.
+
+Host-side packing of byte strings into word lists lives in
+``dwpa_tpu.utils.bytesops`` (plain numpy; runs once per net / per batch).
+"""
+
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+
+def rotl32(x, n: int):
+    """Rotate a uint32 array left by a static amount ``0 < n < 32``."""
+    return (x << n) | (x >> (32 - n))
+
+
+def rotr32(x, n: int):
+    """Rotate a uint32 array right by a static amount ``0 < n < 32``."""
+    return (x >> n) | (x << (32 - n))
+
+
+def u32(x):
+    """Promote a Python int / array to uint32."""
+    return jnp.uint32(x)

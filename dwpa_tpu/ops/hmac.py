@@ -1,0 +1,90 @@
+"""HMAC over the batched word-list primitives.
+
+The WPA pipeline only ever HMACs with keys <= 64 bytes (PSK <= 63, PMK = 32,
+KCK = 16), so the key always fits a single hash block and the ipad/opad
+states can be precomputed once per candidate — two compressions — and then
+reused for every message.  That precomputation is what makes the
+PBKDF2 x 4096 loop cost exactly 2 compressions per iteration
+(see ops/pbkdf2.py).
+
+Message blocks arriving here must already be padded (host-side, see
+utils/bytesops.padded_blocks) with total length accounting for the 64-byte
+key block.  Word entries may be Python ints (constants, folded by XLA) or
+uint32 arrays broadcast against the batch.
+"""
+
+from .common import u32
+from .md5 import md5_compress, md5_init
+from .sha1 import sha1_compress, sha1_init
+from .sha256 import sha256_compress, sha256_init
+
+IPAD = 0x36363636
+OPAD = 0x5C5C5C5C
+
+
+def _xor_block(key_block, pad):
+    return [u32(w) ^ u32(pad) for w in key_block]
+
+
+def hmac_sha1_precompute(key_block, shape=()):
+    """key_block: 16 uint32 words (zero-padded key). -> (istate, ostate)."""
+    i = sha1_compress(sha1_init(shape), _xor_block(key_block, IPAD))
+    o = sha1_compress(sha1_init(shape), _xor_block(key_block, OPAD))
+    return i, o
+
+
+def hmac_md5_precompute(key_block, shape=()):
+    i = md5_compress(md5_init(shape), _xor_block(key_block, IPAD))
+    o = md5_compress(md5_init(shape), _xor_block(key_block, OPAD))
+    return i, o
+
+
+def hmac_sha256_precompute(key_block, shape=()):
+    i = sha256_compress(sha256_init(shape), _xor_block(key_block, IPAD))
+    o = sha256_compress(sha256_init(shape), _xor_block(key_block, OPAD))
+    return i, o
+
+
+def _outer_sha1(ostate, inner_digest):
+    # outer message = 20-byte digest; total hashed = 64 (key) + 20 = 84 bytes
+    blk = list(inner_digest) + [0x80000000] + [0] * 9 + [84 * 8]
+    return sha1_compress(ostate, blk)
+
+
+def hmac_sha1_20(istate, ostate, m5):
+    """HMAC-SHA1 of a 20-byte message given precomputed pad states.
+
+    The PBKDF2 iteration shape: exactly two compressions.
+    ``m5``: 5 uint32 word arrays.
+    """
+    blk = list(m5) + [0x80000000] + [0] * 9 + [84 * 8]
+    inner = sha1_compress(istate, blk)
+    return _outer_sha1(ostate, inner)
+
+
+def hmac_sha1_blocks(istate, ostate, msg_blocks):
+    """HMAC-SHA1 over pre-padded message blocks (after the key block)."""
+    st = istate
+    for blk in msg_blocks:
+        st = sha1_compress(st, blk)
+    return _outer_sha1(ostate, st)
+
+
+def hmac_md5_blocks(istate, ostate, msg_blocks):
+    """HMAC-MD5 over pre-padded (little-endian word) message blocks."""
+    st = istate
+    for blk in msg_blocks:
+        st = md5_compress(st, blk)
+    # outer message = 16-byte digest (4 LE words); total = 64 + 16 = 80 bytes
+    blk = list(st) + [0x80] + [0] * 9 + [80 * 8, 0]
+    return md5_compress(ostate, blk)
+
+
+def hmac_sha256_blocks(istate, ostate, msg_blocks):
+    """HMAC-SHA256 over pre-padded message blocks."""
+    st = istate
+    for blk in msg_blocks:
+        st = sha256_compress(st, blk)
+    # outer message = 32-byte digest; total = 64 + 32 = 96 bytes
+    blk = list(st) + [0x80000000] + [0] * 6 + [96 * 8]
+    return sha256_compress(ostate, blk)

@@ -1,0 +1,69 @@
+"""MD5 as batched uint32-lane JAX ops (RFC 1321).
+
+Used only for the WPA keyver=1 MIC (HMAC-MD5 over the EAPOL frame,
+reference semantics: web/common.php:264), so it is off the hot path —
+still written in the same unrolled word-list style as SHA-1 so one code
+shape serves every primitive.
+
+Note MD5 message words are little-endian; host-side packing handles the
+byte order (utils/bytesops), the compression here is byte-order agnostic.
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from .common import rotl32, u32
+
+IV = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
+
+# Per-round constants straight from the RFC 1321 definition
+# T[i] = floor(2^32 * |sin(i + 1)|).
+T = [int(4294967296 * abs(math.sin(i + 1))) & 0xFFFFFFFF for i in range(64)]
+
+# Rotation amounts per round quartet.
+S = (
+    [7, 12, 17, 22] * 4
+    + [5, 9, 14, 20] * 4
+    + [4, 11, 16, 23] * 4
+    + [6, 10, 15, 21] * 4
+)
+
+
+def md5_init(shape=()):
+    return tuple(jnp.full(shape, v, jnp.uint32) for v in IV)
+
+
+def md5_compress(state, block):
+    """One MD5 compression over a 16-word (little-endian) block."""
+    w = list(block)
+    a, b, c, d = state
+
+    for i in range(64):
+        if i < 16:
+            f = (b & c) | (~b & d)
+            g = i
+        elif i < 32:
+            f = (d & b) | (~d & c)
+            g = (5 * i + 1) % 16
+        elif i < 48:
+            f = b ^ c ^ d
+            g = (3 * i + 5) % 16
+        else:
+            f = c ^ (b | ~d)
+            g = (7 * i) % 16
+        tmp = d
+        d = c
+        c = b
+        b = b + rotl32(a + f + u32(T[i]) + u32(w[g]), S[i])
+        a = tmp
+
+    s0, s1, s2, s3 = state
+    return (s0 + a, s1 + b, s2 + c, s3 + d)
+
+
+def md5_digest_blocks(blocks, shape=()):
+    st = md5_init(shape)
+    for blk in blocks:
+        st = md5_compress(st, blk)
+    return st

@@ -1,0 +1,78 @@
+"""SHA-1 as batched uint32-lane JAX ops (FIPS 180-4).
+
+The 80-round compression is fully unrolled at trace time; the message
+schedule is kept as a rolling Python list so XLA sees straight-line uint32
+arithmetic it can vectorize across the batch axis (each word array carries
+the whole candidate batch in its trailing dims).
+
+This is the inner primitive of the WPA hot loop: PBKDF2-HMAC-SHA1 x 4096
+(reference semantics: web/common.php:179) costs ~16384 of these
+compressions per candidate, so everything else in the framework is designed
+around keeping this function's operands in vector registers.
+"""
+
+import jax.numpy as jnp
+
+from .common import rotl32, u32
+
+# FIPS 180-4 initial state and stage constants.
+IV = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+K0, K1, K2, K3 = 0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6
+
+
+def sha1_init(shape=()):
+    """Initial state as a 5-tuple of uint32 arrays of ``shape``."""
+    return tuple(jnp.full(shape, v, jnp.uint32) for v in IV)
+
+
+def sha1_compress(state, block):
+    """One SHA-1 compression.
+
+    ``state``: 5-tuple of uint32 arrays.  ``block``: list of 16 uint32
+    arrays (big-endian message words); entries may be Python ints for
+    constant words (e.g. padding) — XLA constant-folds them.
+    Returns the new 5-tuple state.
+    """
+    w = list(block)
+    a, b, c, d, e = state
+
+    for t in range(80):
+        if t >= 16:
+            wt = rotl32(
+                u32(w[t - 3]) ^ u32(w[t - 8]) ^ u32(w[t - 14]) ^ u32(w[t - 16]), 1
+            )
+            w.append(wt)
+        if t < 20:
+            f = (b & c) | (~b & d)
+            k = K0
+        elif t < 40:
+            f = b ^ c ^ d
+            k = K1
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+            k = K2
+        else:
+            f = b ^ c ^ d
+            k = K3
+        tmp = rotl32(a, 5) + f + e + u32(k) + u32(w[t])
+        e = d
+        d = c
+        c = rotl32(b, 30)
+        b = a
+        a = tmp
+
+    s0, s1, s2, s3, s4 = state
+    return (s0 + a, s1 + b, s2 + c, s3 + d, s4 + e)
+
+
+def sha1_digest_blocks(blocks, shape=()):
+    """Run the compression over a list of 16-word blocks from the IV.
+
+    ``blocks`` must already contain the 0x80 / length padding.  Returns the
+    5-tuple digest words.  Convenience path for tests and host-prepped
+    fixed-size messages.
+    """
+    st = sha1_init(shape)
+    for blk in blocks:
+        st = sha1_compress(st, blk)
+    return st

@@ -1,0 +1,72 @@
+"""SHA-256 as batched uint32-lane JAX ops (FIPS 180-4).
+
+Used for the WPA2 802.11w keyver=3 PTK derivation
+(HMAC-SHA256 PRF, reference semantics: web/common.php:271).
+Same unrolled word-list style as SHA-1.
+"""
+
+import jax.numpy as jnp
+
+from .common import rotr32, u32
+
+IV = (
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+)
+
+K = (
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+)
+
+
+def sha256_init(shape=()):
+    return tuple(jnp.full(shape, v, jnp.uint32) for v in IV)
+
+
+def sha256_compress(state, block):
+    """One SHA-256 compression over a 16-word (big-endian) block."""
+    w = list(block)
+    for t in range(16, 64):
+        w15 = u32(w[t - 15])
+        w2 = u32(w[t - 2])
+        s0 = rotr32(w15, 7) ^ rotr32(w15, 18) ^ (w15 >> 3)
+        s1 = rotr32(w2, 17) ^ rotr32(w2, 19) ^ (w2 >> 10)
+        w.append(u32(w[t - 16]) + s0 + u32(w[t - 7]) + s1)
+
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + u32(K[t]) + u32(w[t])
+        S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        h = g
+        g = f
+        f = e
+        e = d + t1
+        d = c
+        c = b
+        b = a
+        a = t1 + t2
+
+    s = state
+    return (s[0] + a, s[1] + b, s[2] + c, s[3] + d,
+            s[4] + e, s[5] + f, s[6] + g, s[7] + h)
+
+
+def sha256_digest_blocks(blocks, shape=()):
+    st = sha256_init(shape)
+    for blk in blocks:
+        st = sha256_compress(st, blk)
+    return st

@@ -1,0 +1,91 @@
+"""Host-side byte <-> uint32-word packing (plain numpy).
+
+All variable-length byte handling in the framework happens here, on the
+host, once per net or per candidate batch.  The device kernels only ever
+see fixed-shape uint32 word arrays (see ops/common.py design notes) —
+this is deliberate: TPU/XLA wants static shapes, so strings are padded
+into word lanes before they go anywhere near a jit boundary.
+"""
+
+import struct
+
+import numpy as np
+
+
+def be_words(data: bytes):
+    """bytes (len % 4 == 0) -> list of big-endian 32-bit ints."""
+    assert len(data) % 4 == 0
+    return list(struct.unpack(">%dI" % (len(data) // 4), data))
+
+
+def le_words(data: bytes):
+    """bytes (len % 4 == 0) -> list of little-endian 32-bit ints."""
+    assert len(data) % 4 == 0
+    return list(struct.unpack("<%dI" % (len(data) // 4), data))
+
+
+def md_pad(tail: bytes, total_len: int, little_endian: bool = False):
+    """Merkle–Damgård padding for a message tail.
+
+    ``tail`` is the remaining message after any prior full 64-byte blocks;
+    ``total_len`` is the length in bytes of the *whole* message (including
+    bytes already compressed, e.g. an HMAC key block).  Returns the padded
+    tail as raw bytes (length a multiple of 64).
+
+    ``little_endian`` selects MD5 conventions (LE 64-bit bit-length),
+    otherwise SHA-1/SHA-256 conventions (BE 64-bit bit-length).
+    """
+    data = tail + b"\x80"
+    pad_to = ((len(data) + 8 + 63) // 64) * 64
+    data += b"\x00" * (pad_to - len(data) - 8)
+    if little_endian:
+        data += struct.pack("<Q", total_len * 8)
+    else:
+        data += struct.pack(">Q", total_len * 8)
+    return data
+
+
+def padded_blocks(msg_tail: bytes, total_len: int, little_endian: bool = False):
+    """Pad a message tail and split into 16-word blocks (list of lists)."""
+    data = md_pad(msg_tail, total_len, little_endian)
+    words = le_words(data) if little_endian else be_words(data)
+    return [words[i : i + 16] for i in range(0, len(words), 16)]
+
+
+def message_blocks(data: bytes, little_endian: bool = False):
+    """Split a whole message into padded 16-word blocks (standalone hash)."""
+    nfull = len(data) // 64
+    blocks = []
+    for i in range(nfull):
+        chunk = data[i * 64 : (i + 1) * 64]
+        blocks.append(le_words(chunk) if little_endian else be_words(chunk))
+    blocks += padded_blocks(data[nfull * 64 :], len(data), little_endian)
+    return blocks
+
+
+def pack_passwords_be(passwords, block_words: int = 16) -> np.ndarray:
+    """Pack N password byte-strings into a [N, block_words] uint32 array.
+
+    Each password (<= 4*block_words - 1 bytes; WPA PSKs are 8..63 bytes)
+    becomes one zero-padded 64-byte HMAC key block in big-endian words.
+    Vectorized so the host can keep a TPU fed (millions of rows/s).
+    """
+    n = len(passwords)
+    buf = np.zeros((n, block_words * 4), dtype=np.uint8)
+    for i, pw in enumerate(passwords):
+        b = np.frombuffer(pw, dtype=np.uint8)
+        buf[i, : len(b)] = b
+    return buf.reshape(n, block_words, 4).astype(np.uint32) @ np.array(
+        [1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32
+    )
+
+
+def words_to_bytes_be(words) -> bytes:
+    """Iterable of 32-bit ints -> big-endian bytes."""
+    ws = [int(w) & 0xFFFFFFFF for w in words]
+    return struct.pack(">%dI" % len(ws), *ws)
+
+
+def words_to_bytes_le(words) -> bytes:
+    ws = [int(w) & 0xFFFFFFFF for w in words]
+    return struct.pack("<%dI" % len(ws), *ws)

@@ -1,0 +1,15 @@
+"""Test env: force an 8-device virtual CPU platform before jax imports.
+
+Mirrors how the driver validates multi-chip sharding: a
+``jax.sharding.Mesh`` over 8 virtual CPU devices stands in for a TPU pod
+slice.  Must run before any test module imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
