@@ -1,0 +1,159 @@
+"""hashcat mode-22000 hashline parsing and serialization.
+
+Authoritative format (documented in the reference at web/common.php:114-155):
+
+    WPA*TYPE*PMKID/MIC*MACAP*MACSTA*ESSID*ANONCE*EAPOL*MESSAGEPAIR
+
+    TYPE        01 = PMKID, 02 = EAPOL
+    PMKID/MIC   16 bytes hex
+    MACAP/MACSTA 6 bytes hex
+    ESSID       hex (<= 32 bytes)
+    ANONCE      32 bytes hex (EAPOL only)
+    EAPOL       the M2/M3/M4 frame, MIC zeroed (<= ~320 bytes)
+    MESSAGEPAIR bitmask (EAPOL): bit4 ap-less (no NC), bit5 LE router,
+                bit6 BE router, bit7 replay-count unchecked (NC needed);
+                bits 0-2 encode which messages the pair was taken from.
+                For PMKID lines this trailing field is the PMKID-info mask.
+"""
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+TYPE_PMKID = 1
+TYPE_EAPOL = 2
+
+MP_APLESS = 0x10
+MP_LE = 0x20
+MP_BE = 0x40
+MP_NC_NEEDED = 0x80
+
+
+def _unhex(s: str, what: str) -> bytes:
+    if len(s) % 2 != 0:
+        raise ValueError(f"odd-length hex in {what}: {s!r}")
+    try:
+        return bytes.fromhex(s)
+    except ValueError as e:
+        raise ValueError(f"bad hex in {what}: {s!r}") from e
+
+
+@dataclass(frozen=True)
+class Hashline:
+    """One parsed m22000 hashline."""
+
+    hash_type: int            # TYPE_PMKID | TYPE_EAPOL
+    pmkid_or_mic: bytes       # 16 bytes
+    mac_ap: bytes             # 6 bytes
+    mac_sta: bytes            # 6 bytes
+    essid: bytes              # 1..32 bytes
+    anonce: bytes             # 32 bytes (EAPOL) / b""
+    eapol: bytes              # the frame (EAPOL) / b""
+    message_pair: int         # bitmask; 0 if absent
+    raw: str
+
+    @property
+    def keyver(self) -> int:
+        """EAPOL key descriptor version (key_information & 3); 100 = PMKID.
+
+        Mirrors the nets.keyver column convention (db/wpa.sql:164,
+        web/common.php:217).
+        """
+        if self.hash_type == TYPE_PMKID:
+            return 100
+        return self.key_information & 3
+
+    @property
+    def key_information(self) -> int:
+        return struct.unpack_from(">H", self.eapol, 5)[0]
+
+    @property
+    def snonce(self) -> bytes:
+        return self.eapol[17:49]
+
+    def key_id(self) -> bytes:
+        """Net identity: MD5 over fields 1-7 (excludes message_pair).
+
+        Mirrors hash_m22000 (web/common.php:310-315) so our server's dedup
+        matches the reference's nets.hash column.
+        """
+        parts = self.raw.split("*", 8)
+        return hashlib.md5("".join(parts[1:8]).encode()).digest()
+
+
+def parse(line: str) -> Hashline:
+    """Parse and validate one m22000 hashline."""
+    line = line.strip()
+    parts = line.split("*", 8)
+    if len(parts) != 9:
+        raise ValueError(f"expected 9 *-separated fields, got {len(parts)}")
+    if parts[0] != "WPA":
+        raise ValueError(f"bad signature {parts[0]!r}")
+    if parts[1] not in ("01", "02"):
+        raise ValueError(f"unsupported hash type {parts[1]!r}")
+    hash_type = int(parts[1])
+
+    pmkid_or_mic = _unhex(parts[2], "pmkid/mic")
+    mac_ap = _unhex(parts[3], "mac_ap")
+    mac_sta = _unhex(parts[4], "mac_sta")
+    essid = _unhex(parts[5], "essid")
+    if len(pmkid_or_mic) != 16:
+        raise ValueError("pmkid/mic must be 16 bytes")
+    if len(mac_ap) != 6 or len(mac_sta) != 6:
+        raise ValueError("MACs must be 6 bytes")
+    if not 0 < len(essid) <= 32:
+        raise ValueError("essid must be 1..32 bytes")
+
+    anonce = eapol = b""
+    mp = 0
+    if hash_type == TYPE_EAPOL:
+        anonce = _unhex(parts[6], "anonce")
+        eapol = _unhex(parts[7], "eapol")
+        mp_b = _unhex(parts[8], "message_pair")
+        mp = mp_b[0] if mp_b else 0
+        if len(anonce) != 32:
+            raise ValueError("anonce must be 32 bytes")
+        if len(eapol) < 95:
+            raise ValueError("eapol frame too short")
+    else:
+        mp_b = _unhex(parts[8], "pmkid info") if parts[8] else b""
+        mp = mp_b[0] if mp_b else 0
+
+    return Hashline(
+        hash_type=hash_type,
+        pmkid_or_mic=pmkid_or_mic,
+        mac_ap=mac_ap,
+        mac_sta=mac_sta,
+        essid=essid,
+        anonce=anonce,
+        eapol=eapol,
+        message_pair=mp,
+        raw=line,
+    )
+
+
+def serialize(
+    hash_type: int,
+    pmkid_or_mic: bytes,
+    mac_ap: bytes,
+    mac_sta: bytes,
+    essid: bytes,
+    anonce: bytes = b"",
+    eapol: bytes = b"",
+    message_pair: int | None = None,
+) -> str:
+    """Build an m22000 hashline (used by the capture parser / tests)."""
+    mp = "" if message_pair is None else f"{message_pair:02x}"
+    return "*".join(
+        [
+            "WPA",
+            f"{hash_type:02d}",
+            pmkid_or_mic.hex(),
+            mac_ap.hex(),
+            mac_sta.hex(),
+            essid.hex(),
+            anonce.hex(),
+            eapol.hex(),
+            mp,
+        ]
+    )

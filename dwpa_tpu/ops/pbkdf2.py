@@ -1,0 +1,69 @@
+"""PBKDF2-HMAC-SHA1 -> PMK: the WPA hot kernel.
+
+Reference semantics: ``PMK = PBKDF2-HMAC-SHA1(psk, essid, 4096, 32)``
+(web/common.php:179).  This is ~99% of all cycles in the system, so the
+shape is chosen for the TPU VPU:
+
+- The HMAC ipad/opad states are precomputed once per candidate
+  (2 compressions), so each of the 4096 iterations costs exactly two
+  SHA-1 compressions over a fixed 20-byte message (ops/hmac.hmac_sha1_20).
+- A 32-byte PMK needs two PBKDF2 output blocks T1, T2.  Instead of two
+  sequential loops, the T axis is stacked as a leading dim of size 2 so
+  both blocks ride the same ``lax.fori_loop`` — the device sees one
+  [2, B] batch and the loop body stays two compressions.
+- No data-dependent control flow; iteration count is static; everything
+  is uint32 elementwise math that XLA vectorizes across lanes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .common import u32
+from .hmac import hmac_sha1_20, hmac_sha1_blocks, hmac_sha1_precompute
+
+
+def _stack2(words):
+    """Duplicate each state word along a new leading T axis of size 2."""
+    return tuple(jnp.stack([w, w]) for w in words)
+
+
+def pbkdf2_sha1_pmk(pw_words, salt_block_1, salt_block_2, iterations=4096):
+    """Derive 32-byte PMKs for a batch of candidate passwords.
+
+    ``pw_words``: 16 uint32 arrays of shape [B] — zero-padded 64-byte HMAC
+    key blocks (utils/bytesops.pack_passwords_be).
+    ``salt_block_1/2``: the single pre-padded 16-word message block for
+    ``essid || INT32_BE(i)`` (i = 1, 2) — plain int lists, host-prepped via
+    ``utils.bytesops.padded_blocks(essid + pack('>I', i), 64 + len(essid) + 4)``.
+
+    Returns 8 uint32 arrays of shape [B]: the PMK as big-endian words.
+    """
+    istate, ostate = hmac_sha1_precompute(pw_words)
+    ist2, ost2 = _stack2(istate), _stack2(ostate)
+
+    # First iteration: U1 = HMAC(P, salt || INT(i)), distinct per T block.
+    shape = istate[0].shape
+    salt = [
+        jnp.stack(
+            [
+                jnp.broadcast_to(u32(a), shape),
+                jnp.broadcast_to(u32(b), shape),
+            ]
+        )
+        for a, b in zip(salt_block_1, salt_block_2)
+    ]
+    u1 = hmac_sha1_blocks(ist2, ost2, [salt])
+
+    def body(_, carry):
+        u, acc = carry
+        u = hmac_sha1_20(ist2, ost2, u)
+        acc = tuple(a ^ x for a, x in zip(acc, u))
+        return (u, acc)
+
+    _, acc = jax.lax.fori_loop(1, iterations, body, (u1, u1))
+
+    # PMK = T1 (20 bytes) || T2[:12]  -> 8 big-endian words.
+    return (
+        acc[0][0], acc[1][0], acc[2][0], acc[3][0], acc[4][0],
+        acc[0][1], acc[1][1], acc[2][1],
+    )

@@ -1,0 +1,1 @@
+from .m22000 import check_key_m22000  # noqa: F401

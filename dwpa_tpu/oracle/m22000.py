@@ -1,0 +1,249 @@
+"""Pure-Python m22000 verification oracle (hashlib only, no JAX).
+
+This is a behavioral port of the reference server's independent
+re-verification kernel ``check_key_m22000`` (web/common.php:157-307) — the
+executable spec the device kernels are differentially tested against, and
+the host-side wide-NC re-check the server runs on every submitted PSK
+before accepting it.
+
+Semantics preserved exactly:
+
+- PMKID path: PMK = PBKDF2-HMAC-SHA1(psk, essid, 4096, 32);
+  candidate PMKID = HMAC-SHA1(PMK, "PMK Name" || mac_ap || mac_sta)[:16].
+- EAPOL path: key_information parsed at offset 5 (big-endian), snonce at
+  17:49, keyver = key_information & 3; MAC pair and nonce pair are
+  concatenated in min-order (memcmp of the first 6 bytes);
+  keyver 1/2: PTK = HMAC-SHA1(PMK, "Pairwise key expansion\\0" m n "\\0"),
+  MIC = HMAC-MD5 / HMAC-SHA1 of the EAPOL frame with KCK = PTK[:16];
+  keyver 3: PTK = HMAC-SHA256(PMK, "\\1\\0Pairwise key expansion" m n
+  "\\x80\\1"), MIC = AES-128-CMAC.
+- Nonce-error correction: the last 4 bytes of the AP nonce are replaced by
+  (last +/- i) re-packed little-endian ('V' -> "LE") and big-endian
+  ('N' -> "BE") for i = 1 .. nc/2+1, after trying the exact nonce; the
+  search order (exact; then +1 LE, -1 LE, +1 BE, -1 BE; then +/-2 ...)
+  and the returned (psk, nc, endian, pmk) tuple match the reference,
+  including that the server-side check ignores the message_pair gating
+  bits (the client-side device kernel does use them).
+- hashcat ``$HEX[...]`` password notation is decoded first
+  (web/common.php:3-25).
+"""
+
+import hashlib
+import hmac
+import struct
+
+from ..models import hashline as hl
+
+PRF_LABEL_V12 = b"Pairwise key expansion\x00"
+PRF_LABEL_V3 = b"\x01\x00Pairwise key expansion"
+
+
+def hc_unhex(key):
+    """Decode hashcat $HEX[...] candidate notation to raw bytes."""
+    if isinstance(key, str):
+        key = key.encode("utf-8", errors="ignore")
+    if key.startswith(b"$HEX[") and key.endswith(b"]"):
+        try:
+            return bytes.fromhex(key[5:-1].decode())
+        except ValueError:
+            return key
+    return key
+
+
+# ---------------------------------------------------------------------------
+# Minimal pure-Python AES-128 (encrypt-only) for the CMAC MIC.  Kept free of
+# the JAX implementation on purpose: the oracle must be an independent
+# implementation for differential testing to mean anything.
+# ---------------------------------------------------------------------------
+
+
+def _aes_tables():
+    def gf_mul(a, b):
+        p = 0
+        for _ in range(8):
+            if b & 1:
+                p ^= a
+            hi = a & 0x80
+            a = (a << 1) & 0xFF
+            if hi:
+                a ^= 0x1B
+            b >>= 1
+        return p
+
+    exp, log = [0] * 510, [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x = gf_mul(x, 3)
+    for i in range(255, 510):
+        exp[i] = exp[i - 255]
+    sbox = [0] * 256
+    for v in range(256):
+        inv = 0 if v == 0 else exp[255 - log[v]]
+        s = inv
+        for sh in (1, 2, 3, 4):
+            s ^= ((inv << sh) | (inv >> (8 - sh))) & 0xFF
+        sbox[v] = s ^ 0x63
+    return sbox
+
+
+_SBOX = _aes_tables()
+_RCON = [1, 2, 4, 8, 16, 32, 64, 128, 27, 54]
+
+
+def _aes128_round_keys(key: bytes):
+    w = [list(key[i : i + 4]) for i in range(0, 16, 4)]
+    for i in range(4, 44):
+        t = list(w[i - 1])
+        if i % 4 == 0:
+            t = [_SBOX[t[1]], _SBOX[t[2]], _SBOX[t[3]], _SBOX[t[0]]]
+            t[0] ^= _RCON[i // 4 - 1]
+        w.append([w[i - 4][j] ^ t[j] for j in range(4)])
+    return [sum(w[4 * r : 4 * r + 4], []) for r in range(11)]
+
+
+def _xt(b):
+    return ((b << 1) ^ (0x1B if b & 0x80 else 0)) & 0xFF
+
+
+def _aes128_encrypt(rks, block: bytes) -> bytes:
+    s = [block[i] ^ rks[0][i] for i in range(16)]
+    for r in range(1, 11):
+        s = [_SBOX[b] for b in s]
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if r < 10:
+            ns = []
+            for c in range(4):
+                a0, a1, a2, a3 = s[4 * c : 4 * c + 4]
+                ns += [
+                    _xt(a0) ^ _xt(a1) ^ a1 ^ a2 ^ a3,
+                    a0 ^ _xt(a1) ^ _xt(a2) ^ a2 ^ a3,
+                    a0 ^ a1 ^ _xt(a2) ^ _xt(a3) ^ a3,
+                    _xt(a0) ^ a0 ^ a1 ^ a2 ^ _xt(a3),
+                ]
+            s = ns
+        s = [s[i] ^ rks[r][i] for i in range(16)]
+    return bytes(s)
+
+
+def omac1_aes_128(msg: bytes, key: bytes) -> bytes:
+    """AES-128-CMAC, matching the reference helper (web/common.php:56-112)."""
+
+    def dbl(b: bytes) -> bytes:
+        v = int.from_bytes(b, "big") << 1
+        if b[0] & 0x80:
+            v ^= 0x87
+        return (v & (1 << 128) - 1).to_bytes(16, "big")
+
+    rks = _aes128_round_keys(key)
+    k1 = dbl(_aes128_encrypt(rks, b"\x00" * 16))
+    k2 = dbl(k1)
+
+    n = max(1, (len(msg) + 15) // 16)
+    complete = len(msg) > 0 and len(msg) % 16 == 0
+    last = msg[(n - 1) * 16 :]
+    if complete:
+        last = bytes(a ^ b for a, b in zip(last, k1))
+    else:
+        last = last + b"\x80" + b"\x00" * (15 - len(last))
+        last = bytes(a ^ b for a, b in zip(last, k2))
+
+    c = b"\x00" * 16
+    for i in range(n - 1):
+        c = _aes128_encrypt(rks, bytes(a ^ b for a, b in zip(c, msg[i * 16 : i * 16 + 16])))
+    return _aes128_encrypt(rks, bytes(a ^ b for a, b in zip(c, last)))
+
+
+# ---------------------------------------------------------------------------
+# The verification kernel.
+# ---------------------------------------------------------------------------
+
+
+def pmk_from_psk(psk: bytes, essid: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha1", psk, essid, 4096, 32)
+
+
+def compute_pmkid(pmk: bytes, mac_ap: bytes, mac_sta: bytes) -> bytes:
+    return hmac.new(pmk, b"PMK Name" + mac_ap + mac_sta, hashlib.sha1).digest()[:16]
+
+
+def compute_mic(pmk: bytes, keyver: int, m: bytes, n: bytes, eapol: bytes) -> bytes:
+    """PTK derivation + MIC for one (pmk, nonce-variant)."""
+    if keyver in (1, 2):
+        ptk = hmac.new(pmk, PRF_LABEL_V12 + m + n + b"\x00", hashlib.sha1).digest()
+        kck = ptk[:16]
+        alg = hashlib.md5 if keyver == 1 else hashlib.sha1
+        return hmac.new(kck, eapol, alg).digest()[:16]
+    if keyver == 3:
+        ptk = hmac.new(
+            pmk, PRF_LABEL_V3 + m + n + b"\x80\x01", hashlib.sha256
+        ).digest()
+        return omac1_aes_128(eapol, ptk[:16])
+    raise ValueError(f"unknown keyver {keyver}")
+
+
+def nonce_pairs(h: "hl.Hashline"):
+    """Min-order MAC/nonce concatenation + AP-nonce patch offset."""
+    if h.mac_ap < h.mac_sta:
+        m = h.mac_ap + h.mac_sta
+    else:
+        m = h.mac_sta + h.mac_ap
+    snonce = h.snonce
+    if snonce[:6] < h.anonce[:6]:
+        n, ap_off = snonce + h.anonce, 32
+    else:
+        n, ap_off = h.anonce + snonce, 0
+    return m, n, ap_off
+
+
+def nc_variants(anonce: bytes, nc: int):
+    """Yield (last4_bytes, delta, endian) in reference search order."""
+    last_le = struct.unpack_from("<I", anonce, 28)[0]
+    last_be = struct.unpack_from(">I", anonce, 28)[0]
+    yield anonce[28:32], 0, None
+    halfnc = (nc >> 1) + 1
+    for i in range(1, halfnc + 1):
+        yield struct.pack("<I", (last_le + i) & 0xFFFFFFFF), i, "LE"
+        yield struct.pack("<I", (last_le - i) & 0xFFFFFFFF), -i, "LE"
+        yield struct.pack(">I", (last_be + i) & 0xFFFFFFFF), i, "BE"
+        yield struct.pack(">I", (last_be - i) & 0xFFFFFFFF), -i, "BE"
+
+
+def check_key_m22000(line, keys, pmk=None, nc=128):
+    """Verify candidate PSKs against one hashline.
+
+    Returns ``(psk_bytes, nc_delta, endian, pmk)`` for the first match
+    (``nc_delta``/``endian`` are ``None`` for PMKID; 0/None for an exact
+    EAPOL match), or ``None``.  A provided ``pmk`` skips PBKDF2 for the
+    first key only — the PMK-reuse path (web/common.php:919).
+    """
+    h = line if isinstance(line, hl.Hashline) else hl.parse(line)
+
+    if h.hash_type == hl.TYPE_PMKID:
+        for key in keys:
+            if key is None:
+                continue
+            key = hc_unhex(key)
+            this_pmk = pmk if pmk else pmk_from_psk(key, h.essid)
+            pmk = None
+            if compute_pmkid(this_pmk, h.mac_ap, h.mac_sta) == h.pmkid_or_mic:
+                return key, None, None, this_pmk
+        return None
+
+    keyver = h.keyver
+    if keyver not in (1, 2, 3):
+        # unknown key descriptor version -> not crackable (common.php:274-276)
+        return None
+    m, n, ap_off = nonce_pairs(h)
+    for key in keys:
+        if key is None:
+            continue
+        key = hc_unhex(key)
+        this_pmk = pmk if pmk else pmk_from_psk(key, h.essid)
+        pmk = None
+        for last4, delta, endian in nc_variants(h.anonce, nc):
+            nv = n[: ap_off + 28] + last4 + n[ap_off + 32 :]
+            if compute_mic(this_pmk, keyver, m, nv, h.eapol) == h.pmkid_or_mic:
+                return key, delta, endian, this_pmk
+    return None

@@ -148,3 +148,23 @@ def test_pack_passwords_be():
     for i, pw in enumerate(pws):
         want = bo.be_words(pw + b"\x00" * (64 - len(pw)))
         assert list(arr[i]) == want, pw
+
+
+def test_pbkdf2_sha1_pmk():
+    import hashlib
+    from dwpa_tpu.ops.pbkdf2 import pbkdf2_sha1_pmk
+    from dwpa_tpu.utils.bytesops import padded_blocks
+
+    essid = b"dlink"
+    pws = [b"aaaa1234", b"password", b"x" * 63, b"12345678"]
+    kb = bo.pack_passwords_be(pws)
+    pw_words = [jnp.asarray(kb[:, w]) for w in range(16)]
+    import struct
+
+    s1 = padded_blocks(essid + struct.pack(">I", 1), 64 + len(essid) + 4)[0]
+    s2 = padded_blocks(essid + struct.pack(">I", 2), 64 + len(essid) + 4)[0]
+    pmk_words = pbkdf2_sha1_pmk(pw_words, s1, s2)
+    for i, pw in enumerate(pws):
+        got = bo.words_to_bytes_be([np.asarray(w)[i] for w in pmk_words])
+        want = hashlib.pbkdf2_hmac("sha1", pw, essid, 4096, 32)
+        assert got == want, pw

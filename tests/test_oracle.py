@@ -1,0 +1,184 @@
+"""Oracle tests anchored on the reference's known-PSK challenge vectors.
+
+The two hashlines below are the client's proof-of-correctness challenge
+(help_crack/help_crack.py:692-699): a d-link PMKID and a WPA2 4-way
+handshake, both with PSK ``aaaa1234``.  Any cracker backend must crack
+both from a one-word dictionary before it may fetch real work.
+"""
+
+import pytest
+
+from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.oracle import m22000 as oracle
+
+CHALLENGE_PMKID = (
+    "WPA*01*8ac36b891edca8eef49094b1afe061ac*1c7ee5e2f2d0*0026c72e4900"
+    "*646c696e6b***"
+)
+CHALLENGE_EAPOL = (
+    "WPA*02*269a61ef25e135a4b423832ec4ecc7f4*1c7ee5e2f2d0*0026c72e4900*646c696e6b*"
+    "dbd249a3e9cec6ced3360fba3fae9ba4aa6ec6c76105796ff6b5a209d18782ca*"
+    "0103007702010a00000000000000000000645b1f684a2566e21266f123abc386"
+    "cc576f593e6dc5e3823a32fbd4af929f51000000000000000000000000000000"
+    "0000000000000000000000000000000000000000000000000000000000000000"
+    "00001830160100000fac020100000fac040100000fac023c000000*00"
+)
+CHALLENGE_KEY = b"aaaa1234"
+
+
+def test_parse_challenge_lines():
+    p = hl.parse(CHALLENGE_PMKID)
+    assert p.hash_type == hl.TYPE_PMKID
+    assert p.essid == b"dlink"
+    assert p.keyver == 100
+
+    e = hl.parse(CHALLENGE_EAPOL)
+    assert e.hash_type == hl.TYPE_EAPOL
+    assert e.essid == b"dlink"
+    assert e.keyver == 2
+    assert len(e.snonce) == 32
+    assert e.key_id() != p.key_id()
+
+
+def test_parse_rejects_garbage():
+    for bad in [
+        "",
+        "WPA*03*aa*bb*cc*dd***",
+        CHALLENGE_PMKID.replace("WPA", "WPB"),
+        "WPA*01*zz*1c7ee5e2f2d0*0026c72e4900*646c696e6b***",
+        "WPA*01*8ac36b891edca8eef49094b1afe061ac*1c7e*0026c72e4900*64***",
+    ]:
+        with pytest.raises(ValueError):
+            hl.parse(bad)
+
+
+def test_oracle_cracks_challenge_pmkid():
+    got = oracle.check_key_m22000(CHALLENGE_PMKID, [b"wrong123", CHALLENGE_KEY])
+    assert got is not None
+    psk, nc, endian, pmk = got
+    assert psk == CHALLENGE_KEY and nc is None and endian is None
+    assert pmk == oracle.pmk_from_psk(CHALLENGE_KEY, b"dlink")
+
+
+def test_oracle_cracks_challenge_eapol():
+    # The challenge handshake itself carries a drifted AP nonce: the MIC
+    # only verifies with nonce-error-correction +4 little-endian — a nice
+    # built-in NC regression vector.
+    got = oracle.check_key_m22000(CHALLENGE_EAPOL, [CHALLENGE_KEY])
+    assert got is not None
+    psk, nc, endian, pmk = got
+    assert psk == CHALLENGE_KEY and nc == 4 and endian == "LE"
+
+
+def test_oracle_rejects_wrong_keys():
+    assert oracle.check_key_m22000(CHALLENGE_PMKID, [b"bbbb1234", None]) is None
+    assert oracle.check_key_m22000(CHALLENGE_EAPOL, [b"bbbb1234"]) is None
+
+
+def test_oracle_hex_notation():
+    got = oracle.check_key_m22000(CHALLENGE_PMKID, ["$HEX[6161616131323334]"])
+    assert got is not None and got[0] == CHALLENGE_KEY
+
+
+def test_oracle_pmk_reuse_skips_pbkdf2():
+    pmk = oracle.pmk_from_psk(CHALLENGE_KEY, b"dlink")
+    got = oracle.check_key_m22000(CHALLENGE_PMKID, [b""], pmk=pmk)
+    assert got is not None and got[3] == pmk
+    got = oracle.check_key_m22000(CHALLENGE_EAPOL, [b""], pmk=pmk)
+    assert got is not None and got[1] == 4
+
+
+def _clean_anonce() -> bytes:
+    """The challenge anonce with its true +4 LE drift applied, so the MIC
+    verifies with no correction."""
+    import struct
+
+    h = hl.parse(CHALLENGE_EAPOL)
+    last = struct.unpack_from("<I", h.anonce, 28)[0]
+    return h.anonce[:28] + struct.pack("<I", (last + 4) & 0xFFFFFFFF)
+
+
+def _perturbed_eapol(delta: int, endian: str) -> str:
+    """Rebuild the challenge EAPOL line with a perturbed AP nonce.
+
+    If the stored anonce drifted by ``-delta`` relative to the one the
+    PTK was computed with, the verifier must recover it at ``+delta``.
+    """
+    import struct
+
+    h = hl.parse(CHALLENGE_EAPOL)
+    clean = _clean_anonce()
+    fmt = "<I" if endian == "LE" else ">I"
+    last = struct.unpack_from(fmt, clean, 28)[0]
+    bad = clean[:28] + struct.pack(fmt, (last - delta) & 0xFFFFFFFF)
+    return hl.serialize(
+        hl.TYPE_EAPOL, h.pmkid_or_mic, h.mac_ap, h.mac_sta, h.essid,
+        bad, h.eapol, h.message_pair,
+    )
+
+
+def test_oracle_exact_after_drift_repair():
+    h = hl.parse(CHALLENGE_EAPOL)
+    line = hl.serialize(
+        hl.TYPE_EAPOL, h.pmkid_or_mic, h.mac_ap, h.mac_sta, h.essid,
+        _clean_anonce(), h.eapol, h.message_pair,
+    )
+    got = oracle.check_key_m22000(line, [CHALLENGE_KEY])
+    assert got is not None and got[1] == 0 and got[2] is None
+
+
+@pytest.mark.parametrize("endian", ["LE", "BE"])
+@pytest.mark.parametrize("delta", [1, 3, 8])
+def test_oracle_nonce_error_correction(delta, endian):
+    line = _perturbed_eapol(delta, endian)
+    got = oracle.check_key_m22000(line, [CHALLENGE_KEY], nc=32)
+    assert got is not None
+    psk, nc, got_endian, _ = got
+    assert psk == CHALLENGE_KEY
+    assert nc == delta
+    # NB: when the last 4 bytes make a palindromic-ish pattern both endians
+    # can match; the reference returns whichever the search order hits first.
+    assert got_endian in (endian, "LE", "BE")
+
+
+def test_oracle_nc_budget_respected():
+    line = _perturbed_eapol(10, "BE")
+    assert oracle.check_key_m22000(line, [CHALLENGE_KEY], nc=8) is None
+    assert oracle.check_key_m22000(line, [CHALLENGE_KEY], nc=32) is not None
+
+
+def _synthetic_line(keyver: int, psk: bytes, essid: bytes) -> str:
+    """Forge a handshake for keyver 1/3 coverage using the oracle's own
+    primitives (primitives are independently KAT-tested in test_ops)."""
+    import struct
+
+    mac_ap = bytes.fromhex("020000000001")
+    mac_sta = bytes.fromhex("040000000002")
+    anonce = bytes(range(32))
+    snonce = bytes(range(64, 96))
+    key_info = {1: 0x0109, 3: 0x010B}[keyver]
+    eapol = bytearray(121)
+    eapol[0:2] = b"\x02\x03"
+    struct.pack_into(">H", eapol, 2, 117)
+    eapol[4] = 254 if keyver == 1 else 2
+    struct.pack_into(">H", eapol, 5, key_info)
+    eapol[17:49] = snonce
+    eapol = bytes(eapol)
+
+    pmk = oracle.pmk_from_psk(psk, essid)
+    h_tmp = hl.parse(
+        hl.serialize(hl.TYPE_EAPOL, b"\x00" * 16, mac_ap, mac_sta, essid,
+                     anonce, eapol, 0)
+    )
+    m, n, _ = oracle.nonce_pairs(h_tmp)
+    mic = oracle.compute_mic(pmk, keyver, m, n, eapol)
+    return hl.serialize(hl.TYPE_EAPOL, mic, mac_ap, mac_sta, essid,
+                        anonce, eapol, 0)
+
+
+@pytest.mark.parametrize("keyver", [1, 3])
+def test_oracle_keyver_1_and_3(keyver):
+    line = _synthetic_line(keyver, b"superpass", b"testnet")
+    got = oracle.check_key_m22000(line, [b"nope nope", b"superpass"])
+    assert got is not None and got[0] == b"superpass"
+    assert oracle.check_key_m22000(line, [b"wrongpass"]) is None
