@@ -1,0 +1,430 @@
+"""m22000 (WPA PMKID / EAPOL 4-way) device cracking engine.
+
+The flagship model of the framework: candidate PSKs -> PBKDF2-HMAC-SHA1
+-> PMK -> PMKID-HMAC or PRF+MIC verification with nonce-error-correction,
+entirely on device as batched uint32-lane JAX ops.
+
+Reference semantics being matched (never copied — see the pure-Python
+oracle at dwpa_tpu/oracle/m22000.py for the executable spec):
+
+- server verifier ``check_key_m22000`` (web/common.php:157-307);
+- hashcat client invocation ``--nonce-error-corrections=8``
+  (help_crack/help_crack.py:773) — the device searches the same +/-NC
+  window the GPU cracker does, while wide-NC re-checks stay host-side;
+- message_pair gating bits (web/common.php:114-155, and the client's
+  BE/LE handling at help_crack/help_crack.py:378-400): bit4 ap-less =>
+  exact nonce only; bit5/bit6 restrict the NC search to LE/BE.
+
+TPU-first design:
+
+- The PBKDF2 kernel (ops/pbkdf2.py) takes the ESSID salt blocks as *data*,
+  so one XLA compilation serves every ESSID at a given batch size.
+- Verification kernels take per-net constants (PRF message variants, padded
+  EAPOL blocks, target words) as arrays and ``vmap`` over the NC-variant
+  axis, so compilations are shared across nets with the same
+  (keyver, n_variants, n_eapol_blocks) signature.
+- All byte wrangling happens host-side in numpy; the device only ever sees
+  fixed-shape uint32 arrays.
+"""
+
+import struct
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import hmac as hm
+from ..ops.aes import aes128_cmac
+from ..ops.common import bswap32, u32
+from ..ops.pbkdf2 import pbkdf2_sha1_pmk
+from ..oracle import m22000 as oracle
+from ..utils import bytesops as bo
+from . import hashline as hl
+
+# Minimum/maximum WPA passphrase length (IEEE 802.11i; enforced by the
+# reference dict guidance at INSTALL.md:83 and by hashcat itself).
+MIN_PSK_LEN = 8
+MAX_PSK_LEN = 63
+
+DEFAULT_NC = 8  # client-side hashcat window (help_crack.py:773)
+
+
+# ---------------------------------------------------------------------------
+# Host-side per-net preparation
+# ---------------------------------------------------------------------------
+
+
+def essid_salt_blocks(essid: bytes):
+    """The two PBKDF2 single-block salt messages ``essid || INT32_BE(i)``.
+
+    ESSIDs are <= 32 bytes so ``essid + 4`` always fits one padded SHA-1
+    block (after the 64-byte HMAC key block).  Returned as uint32[16]
+    arrays — *data*, not trace constants, so the PMK kernel compiles once.
+    """
+    out = []
+    for i in (1, 2):
+        tail = essid + struct.pack(">I", i)
+        blk = bo.padded_blocks(tail, 64 + len(tail))[0]
+        out.append(np.asarray(blk, dtype=np.uint32))
+    return out[0], out[1]
+
+
+def _hmac_msg_blocks(data: bytes, little_endian: bool = False) -> np.ndarray:
+    """Pad an HMAC inner message (keyed by one 64-byte block) -> [nb, 16]."""
+    return np.asarray(
+        bo.message_blocks(data, little_endian, prefix_len=64), dtype=np.uint32
+    )
+
+
+def _nc_variants(h: hl.Hashline, nc: int):
+    """(last4, delta, endian) list honoring message_pair gating bits."""
+    variants = [(h.anonce[28:32], 0, None)]
+    if h.message_pair & hl.MP_APLESS:
+        return variants  # M1/M2 from the AP's own frame: nonce is exact
+    endians = []
+    if h.message_pair & hl.MP_LE:
+        endians.append("LE")
+    if h.message_pair & hl.MP_BE:
+        endians.append("BE")
+    if not endians:
+        endians = ["LE", "BE"]
+    last_le = struct.unpack_from("<I", h.anonce, 28)[0]
+    last_be = struct.unpack_from(">I", h.anonce, 28)[0]
+    for i in range(1, (nc >> 1) + 2):
+        for e in endians:
+            if e == "LE":
+                variants.append((struct.pack("<I", (last_le + i) & 0xFFFFFFFF), i, "LE"))
+                variants.append((struct.pack("<I", (last_le - i) & 0xFFFFFFFF), -i, "LE"))
+            else:
+                variants.append((struct.pack(">I", (last_be + i) & 0xFFFFFFFF), i, "BE"))
+                variants.append((struct.pack(">I", (last_be - i) & 0xFFFFFFFF), -i, "BE"))
+    return variants
+
+
+@dataclass
+class PreppedNet:
+    """Device-ready constants for one hashline."""
+
+    line: hl.Hashline
+    keyver: int                      # 1 | 2 | 3 | 100 (PMKID)
+    target: np.ndarray               # uint32[4] (PMKID/MIC words; LE for keyver 1)
+    # PMKID path
+    pmkid_block: np.ndarray = None   # uint32[16]
+    # EAPOL path
+    variants: tuple = ()             # ((delta, endian), ...) aligned with prf_blocks
+    prf_blocks: np.ndarray = None    # uint32[V, 2, 16] PRF inner-message variants
+    eapol_blocks: np.ndarray = None  # uint32[E, 16] (keyver 1: LE words, 2: BE)
+    # keyver 3 (AES-128-CMAC MIC)
+    cmac_full: np.ndarray = None     # uint32[F, 16] byte values
+    cmac_last: np.ndarray = None     # uint32[16] byte values (10*-padded)
+    cmac_last_complete: bool = False
+    cmac_target: np.ndarray = None   # uint32[16] byte values
+
+
+def prep_net(h: hl.Hashline, nc: int = DEFAULT_NC) -> PreppedNet:
+    """Precompute every per-net constant the device kernels need."""
+    if h.hash_type == hl.TYPE_PMKID:
+        msg = b"PMK Name" + h.mac_ap + h.mac_sta
+        return PreppedNet(
+            line=h,
+            keyver=100,
+            target=np.asarray(bo.be_words(h.pmkid_or_mic), dtype=np.uint32),
+            pmkid_block=_hmac_msg_blocks(msg)[0],
+        )
+
+    keyver = h.keyver
+    if keyver not in (1, 2, 3):
+        raise ValueError(f"uncrackable key descriptor version {keyver}")
+    m, n, ap_off = oracle.nonce_pairs(h)
+    variants = _nc_variants(h, nc)
+    prf = []
+    for last4, _, _ in variants:
+        nv = n[: ap_off + 28] + last4 + n[ap_off + 32 :]
+        if keyver == 3:
+            msg = oracle.PRF_LABEL_V3 + m + nv + b"\x80\x01"
+        else:
+            msg = oracle.PRF_LABEL_V12 + m + nv + b"\x00"
+        prf.append(_hmac_msg_blocks(msg))
+    prepped = PreppedNet(
+        line=h,
+        keyver=keyver,
+        target=np.asarray(
+            bo.le_words(h.pmkid_or_mic) if keyver == 1 else bo.be_words(h.pmkid_or_mic),
+            dtype=np.uint32,
+        )[:4],
+        variants=tuple((d, e) for _, d, e in variants),
+        prf_blocks=np.stack(prf),
+    )
+    if keyver == 3:
+        ep = h.eapol
+        nblk = max(1, (len(ep) + 15) // 16)
+        complete = len(ep) > 0 and len(ep) % 16 == 0
+        last = ep[(nblk - 1) * 16 :]
+        if not complete:
+            last = last + b"\x80" + b"\x00" * (15 - len(last))
+        prepped.cmac_full = np.frombuffer(
+            ep[: (nblk - 1) * 16], dtype=np.uint8
+        ).reshape(nblk - 1, 16).astype(np.uint32)
+        prepped.cmac_last = np.frombuffer(last, dtype=np.uint8).astype(np.uint32)
+        prepped.cmac_last_complete = complete
+        prepped.cmac_target = np.frombuffer(h.pmkid_or_mic, dtype=np.uint8).astype(
+            np.uint32
+        )
+    else:
+        prepped.eapol_blocks = _hmac_msg_blocks(h.eapol, little_endian=(keyver == 1))
+    return prepped
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+
+def _rows(arr2d, n=None):
+    """[R, 16] array -> list of row-lists of traced scalars."""
+    r = arr2d.shape[0] if n is None else n
+    return [[arr2d[i, j] for j in range(16)] for i in range(r)]
+
+
+def _pmk_impl(pw_words, salt1, salt2):
+    pw = [pw_words[:, i] for i in range(16)]
+    s1 = [salt1[i] for i in range(16)]
+    s2 = [salt2[i] for i in range(16)]
+    return jnp.stack(pbkdf2_sha1_pmk(pw, s1, s2))
+
+
+#: pmk_kernel(pw_words[B,16], salt1[16], salt2[16]) -> uint32[8, B]
+pmk_kernel = jax.jit(_pmk_impl)
+
+
+def _pmk_key_block(pmk):
+    return [pmk[i] for i in range(8)] + [0] * 8
+
+
+def _eq4(out, target):
+    m = out[0] == target[0]
+    for i in range(1, 4):
+        m = m & (out[i] == target[i])
+    return m
+
+
+def _pmkid_impl(pmk, msg_block, target):
+    shape = pmk.shape[1:]
+    ist, ost = hm.hmac_sha1_precompute(_pmk_key_block(pmk), shape)
+    out = hm.hmac_sha1_blocks(ist, ost, [[msg_block[i] for i in range(16)]])
+    return _eq4(out, target)
+
+
+#: pmkid_kernel(pmk[8,B], msg_block[16], target[4]) -> bool[B]
+pmkid_kernel = jax.jit(_pmkid_impl)
+
+
+@partial(jax.jit, static_argnames=("keyver",))
+def eapol_kernel(pmk, prf_blocks, eapol_blocks, target, *, keyver):
+    """MIC match for keyver 1/2 over all NC variants.
+
+    ``pmk``: uint32[8, B]; ``prf_blocks``: uint32[V, 2, 16];
+    ``eapol_blocks``: uint32[E, 16]; ``target``: uint32[4].
+    Returns bool[V, B].
+    """
+    shape = pmk.shape[1:]
+    ist, ost = hm.hmac_sha1_precompute(_pmk_key_block(pmk), shape)
+    eap = _rows(eapol_blocks)
+
+    def per_variant(blk2):
+        prf = hm.hmac_sha1_blocks(ist, ost, _rows(blk2, 2))
+        kck = list(prf[:4])
+        if keyver == 1:
+            kb = [bswap32(w) for w in kck] + [0] * 12
+            ii, oo = hm.hmac_md5_precompute(kb, shape)
+            out = hm.hmac_md5_blocks(ii, oo, eap)
+        else:
+            kb = kck + [0] * 12
+            ii, oo = hm.hmac_sha1_precompute(kb, shape)
+            out = hm.hmac_sha1_blocks(ii, oo, eap)
+        return _eq4(out, target)
+
+    return jax.vmap(per_variant)(prf_blocks)
+
+
+@partial(jax.jit, static_argnames=("last_complete",))
+def eapol_cmac_kernel(pmk, prf_blocks, cmac_full, cmac_last, target, *, last_complete):
+    """AES-128-CMAC MIC match (keyver 3, WPA2 802.11w) -> bool[V, B]."""
+    shape = pmk.shape[1:]
+    ist, ost = hm.hmac_sha256_precompute(_pmk_key_block(pmk), shape)
+    full = _rows(cmac_full) if cmac_full.shape[0] else []
+    last = [cmac_last[i] for i in range(16)]
+
+    def per_variant(blk2):
+        prf = hm.hmac_sha256_blocks(ist, ost, _rows(blk2, 2))
+        kck_bytes = []
+        for w in prf[:4]:
+            kck_bytes += [
+                (w >> 24) & u32(0xFF),
+                (w >> 16) & u32(0xFF),
+                (w >> 8) & u32(0xFF),
+                w & u32(0xFF),
+            ]
+        mac = aes128_cmac(kck_bytes, full, last, last_complete)
+        m = mac[0] == target[0]
+        for i in range(1, 16):
+            m = m & (mac[i] == target[i])
+        return m
+
+    return jax.vmap(per_variant)(prf_blocks)
+
+
+def verify_net(pmk, net: PreppedNet):
+    """Dispatch one prepped net against a PMK batch.
+
+    Returns (found bool[B], variant_idx int[B]) as numpy arrays; for PMKID
+    nets variant_idx is all zeros.
+    """
+    if net.keyver == 100:
+        m = pmkid_kernel(pmk, jnp.asarray(net.pmkid_block), jnp.asarray(net.target))
+        m = np.array(m)
+        return m, np.zeros(m.shape, dtype=np.int32)
+    if net.keyver == 3:
+        mv = eapol_cmac_kernel(
+            pmk,
+            jnp.asarray(net.prf_blocks),
+            jnp.asarray(net.cmac_full),
+            jnp.asarray(net.cmac_last),
+            jnp.asarray(net.cmac_target),
+            last_complete=net.cmac_last_complete,
+        )
+    else:
+        mv = eapol_kernel(
+            pmk,
+            jnp.asarray(net.prf_blocks),
+            jnp.asarray(net.eapol_blocks),
+            jnp.asarray(net.target),
+            keyver=net.keyver,
+        )
+    mv = np.array(mv)  # [V, B]
+    return mv.any(axis=0), mv.argmax(axis=0).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Found:
+    """One cracked net, shaped like the reference's verifier return value
+    ``[PSK, NC, BE/LE, PMK]`` (web/common.php:152-155)."""
+
+    line: hl.Hashline
+    psk: bytes
+    nc: int            # signed NC delta (0 = exact)
+    endian: str        # "LE" | "BE" | "" (exact / PMKID)
+    pmk: bytes
+
+
+class M22000Engine:
+    """Crack a set of m22000 hashlines with batches of candidate PSKs.
+
+    ESSID grouping mirrors the reference scheduler's amortization trick
+    (web/content/get_work.php:96-109): one PBKDF2 per (candidate, ESSID)
+    feeds the PMKID/MIC checks of every net sharing that ESSID.
+    """
+
+    def __init__(self, lines, nc: int = DEFAULT_NC, batch_size: int = 4096,
+                 verify_with_oracle: bool = True):
+        self.batch_size = int(batch_size)
+        self.nc = nc
+        self.verify_with_oracle = verify_with_oracle
+        self.groups = {}  # essid -> list[PreppedNet]
+        self.skipped = []
+        for line in lines:
+            try:
+                h = line if isinstance(line, hl.Hashline) else hl.parse(line)
+                net = prep_net(h, nc=nc)
+            except ValueError:
+                self.skipped.append(line)
+                continue
+            self.groups.setdefault(h.essid, []).append(net)
+        self._salts = {e: essid_salt_blocks(e) for e in self.groups}
+
+    @property
+    def nets(self):
+        return [n for group in self.groups.values() for n in group]
+
+    def remove(self, found: Found):
+        """Drop a cracked net (and empty groups) from further batches."""
+        group = self.groups.get(found.line.essid)
+        if not group:
+            return
+        group[:] = [n for n in group if n.line is not found.line]
+        if not group:
+            del self.groups[found.line.essid]
+            del self._salts[found.line.essid]
+
+    def pmk_batch(self, essid: bytes, pw_words) -> jax.Array:
+        """PBKDF2 a packed password batch for one ESSID -> uint32[8, B]."""
+        s1, s2 = self._salts.get(essid) or essid_salt_blocks(essid)
+        return pmk_kernel(jnp.asarray(pw_words), jnp.asarray(s1), jnp.asarray(s2))
+
+    def crack_batch(self, passwords) -> list:
+        """One fixed-size batch of candidate byte-strings -> list[Found]."""
+        # $HEX[...] notation decodes to raw bytes before hashing, matching
+        # the server's candidate handling (hc_unhex, web/common.php:3-25).
+        pws = [oracle.hc_unhex(p) for p in passwords]
+        pws = [p for p in pws if MIN_PSK_LEN <= len(p) <= MAX_PSK_LEN]
+        if not pws:
+            return []
+        nvalid = len(pws)
+        if nvalid < self.batch_size:
+            pws = pws + [b"\x00" * MIN_PSK_LEN] * (self.batch_size - nvalid)
+        pw_words = bo.pack_passwords_be(pws)
+        founds = []
+        for essid, group in list(self.groups.items()):
+            pmk = self.pmk_batch(essid, pw_words)
+            pmk_host = None
+            for net in list(group):
+                found, vidx = verify_net(pmk, net)
+                found[nvalid:] = False
+                if not found.any():
+                    continue
+                if pmk_host is None:
+                    pmk_host = np.asarray(pmk)
+                for b in np.flatnonzero(found):
+                    delta, endian = (0, None)
+                    if net.keyver != 100:
+                        delta, endian = net.variants[int(vidx[b])]
+                    pmk_bytes = bo.words_to_bytes_be(pmk_host[:, b])
+                    if self.verify_with_oracle:
+                        chk = oracle.check_key_m22000(net.line, [pws[b]], nc=self.nc)
+                        if chk is None:
+                            continue  # device false positive: reject like the server would
+                    founds.append(
+                        Found(
+                            line=net.line,
+                            psk=pws[b],
+                            nc=delta,
+                            endian=endian or "",
+                            pmk=pmk_bytes,
+                        )
+                    )
+                    break  # one PSK per net is enough
+        for f in founds:
+            self.remove(f)
+        return founds
+
+    def crack(self, candidates) -> list:
+        """Stream candidates in engine-sized batches until exhausted."""
+        founds = []
+        batch = []
+        for pw in candidates:
+            if not self.groups:
+                break
+            batch.append(pw)
+            if len(batch) == self.batch_size:
+                founds += self.crack_batch(batch)
+                batch = []
+        if batch and self.groups:
+            founds += self.crack_batch(batch)
+        return founds

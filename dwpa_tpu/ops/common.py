@@ -32,3 +32,14 @@ def rotr32(x, n: int):
 def u32(x):
     """Promote a Python int / array to uint32."""
     return jnp.uint32(x)
+
+
+def bswap32(x):
+    """Byte-swap a uint32 array (BE word <-> LE word)."""
+    x = u32(x)
+    return (
+        ((x & u32(0xFF)) << 24)
+        | ((x & u32(0xFF00)) << 8)
+        | ((x >> 8) & u32(0xFF00))
+        | (x >> 24)
+    )

@@ -1,0 +1,112 @@
+"""Synthetic m22000 fixture builders.
+
+Generates valid hashlines with *known* PSKs entirely from our own oracle
+(dwpa_tpu/oracle/m22000.py), so tests never depend on captured data: build
+an EAPOL-Key frame, derive the real MIC for the chosen PSK, then serialize
+the line the way a capture converter would (format spec documented in the
+reference at web/common.php:114-155).
+
+Also used by the server tests as the source of fake submissions, mirroring
+how the reference's only correctness fixture works (the hardcoded known-PSK
+challenge at help_crack/help_crack.py:690-725).
+"""
+
+import hashlib
+import struct
+
+from .models import hashline as hl
+from .oracle import m22000 as oracle
+
+
+def _rand(seed: str, n: int) -> bytes:
+    """Deterministic pseudo-random bytes (stable fixtures, no RNG state)."""
+    out = b""
+    i = 0
+    while len(out) < n:
+        out += hashlib.sha256(f"{seed}:{i}".encode()).digest()
+        i += 1
+    return out[:n]
+
+
+def make_pmkid_line(psk: bytes, essid: bytes, seed: str = "pmkid") -> str:
+    """A PMKID hashline whose PSK is ``psk``."""
+    mac_ap = _rand(seed + "ap", 6)
+    mac_sta = _rand(seed + "sta", 6)
+    pmk = oracle.pmk_from_psk(psk, essid)
+    pmkid = oracle.compute_pmkid(pmk, mac_ap, mac_sta)
+    return hl.serialize(hl.TYPE_PMKID, pmkid, mac_ap, mac_sta, essid, message_pair=1)
+
+
+def build_eapol_m2(key_information: int, snonce: bytes, key_data: bytes = b"") -> bytes:
+    """A structurally-valid EAPOL-Key (message 2) frame with a zeroed MIC.
+
+    Layout per IEEE 802.1X / 802.11i: version, type=3 (Key), BE length,
+    descriptor type, key_information at offset 5 (where the verifier reads
+    it), snonce at 17:49, zero MIC at 81:97.
+    """
+    body = struct.pack(
+        ">BHH8s32s16s8s8s16sH",
+        2,                      # descriptor type (RSN)
+        key_information,
+        0,                      # key length (0 in M2)
+        b"\x00" * 7 + b"\x01",  # replay counter
+        snonce,
+        b"\x00" * 16,           # key IV
+        b"\x00" * 8,            # key RSC
+        b"\x00" * 8,            # key ID
+        b"\x00" * 16,           # MIC (zeroed for MIC computation/storage)
+        len(key_data),
+    ) + key_data
+    return struct.pack(">BBH", 2, 3, len(body)) + body
+
+
+def make_eapol_line(
+    psk: bytes,
+    essid: bytes,
+    keyver: int = 2,
+    nc_delta: int = 0,
+    endian: str = "LE",
+    message_pair: int = 0x00,
+    seed: str = "eapol",
+    key_data: bytes = None,
+) -> str:
+    """An EAPOL hashline whose PSK is ``psk``.
+
+    ``nc_delta``/``endian`` simulate a nonce-incrementing router: the MIC is
+    derived from the *corrected* AP nonce while the line stores the captured
+    one, so a verifier must apply +nc_delta (re-packed per ``endian``) to
+    match — exercising the reference's NC search semantics
+    (web/common.php:234-300).
+    """
+    mac_ap = _rand(seed + "ap", 6)
+    mac_sta = _rand(seed + "sta", 6)
+    anonce_rec = _rand(seed + "anonce", 32)
+    snonce = _rand(seed + "snonce", 32)
+    if key_data is None:
+        key_data = _rand(seed + "rsnie", 22)
+
+    key_information = {1: 0x0109, 2: 0x010A, 3: 0x010B}[keyver]
+    eapol = build_eapol_m2(key_information, snonce, key_data)
+
+    # The nonce the router actually used (what the MIC is computed over).
+    anonce_real = anonce_rec
+    if nc_delta:
+        fmt = "<I" if endian == "LE" else ">I"
+        last = struct.unpack_from(fmt, anonce_rec, 28)[0]
+        anonce_real = anonce_rec[:28] + struct.pack(fmt, (last + nc_delta) & 0xFFFFFFFF)
+        message_pair |= hl.MP_NC_NEEDED
+
+    pmk = oracle.pmk_from_psk(psk, essid)
+    if mac_ap < mac_sta:
+        m = mac_ap + mac_sta
+    else:
+        m = mac_sta + mac_ap
+    if snonce[:6] < anonce_real[:6]:
+        n = snonce + anonce_real
+    else:
+        n = anonce_real + snonce
+    mic = oracle.compute_mic(pmk, keyver, m, n, eapol)
+
+    return hl.serialize(
+        hl.TYPE_EAPOL, mic, mac_ap, mac_sta, essid, anonce_rec, eapol, message_pair
+    )

@@ -52,14 +52,18 @@ def padded_blocks(msg_tail: bytes, total_len: int, little_endian: bool = False):
     return [words[i : i + 16] for i in range(0, len(words), 16)]
 
 
-def message_blocks(data: bytes, little_endian: bool = False):
-    """Split a whole message into padded 16-word blocks (standalone hash)."""
+def message_blocks(data: bytes, little_endian: bool = False, prefix_len: int = 0):
+    """Split a whole message into padded 16-word blocks.
+
+    ``prefix_len`` counts bytes already compressed before ``data`` (e.g. the
+    64-byte HMAC key block) toward the length field, without emitting them.
+    """
     nfull = len(data) // 64
     blocks = []
     for i in range(nfull):
         chunk = data[i * 64 : (i + 1) * 64]
         blocks.append(le_words(chunk) if little_endian else be_words(chunk))
-    blocks += padded_blocks(data[nfull * 64 :], len(data), little_endian)
+    blocks += padded_blocks(data[nfull * 64 :], prefix_len + len(data), little_endian)
     return blocks
 
 

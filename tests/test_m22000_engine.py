@@ -1,0 +1,126 @@
+"""Differential tests: device m22000 engine vs the pure-Python oracle.
+
+Fixtures are synthesized (dwpa_tpu/testing.py) with known PSKs, mirroring
+the role of the reference client's hardcoded known-PSK challenge gate
+(help_crack/help_crack.py:690-725): the engine must crack them from a small
+dictionary and agree with the oracle on (psk, nc, endian, pmk).
+"""
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.models.m22000 import M22000Engine
+from dwpa_tpu.oracle import m22000 as oracle
+
+BATCH = 64
+
+
+def small_dict(*planted):
+    words = [f"word{i:04d}xx".encode() for i in range(40)]
+    out = []
+    for i, w in enumerate(words):
+        out.append(w)
+        for j, p in enumerate(planted):
+            if i == 7 * (j + 1):
+                out.append(p)
+    return out
+
+
+def crack_one(line, psk):
+    eng = M22000Engine([line], batch_size=BATCH)
+    founds = eng.crack(small_dict(psk))
+    assert len(founds) == 1
+    f = founds[0]
+    assert f.psk == psk
+    # oracle must agree bit-for-bit (pmk + nc semantics)
+    o = oracle.check_key_m22000(line, [psk])
+    assert o is not None
+    assert f.pmk == o[3]
+    return f
+
+
+def test_pmkid_crack():
+    psk = b"s3cretpass"
+    f = crack_one(tfx.make_pmkid_line(psk, b"TestNet"), psk)
+    assert f.nc == 0 and f.endian == ""
+
+
+@pytest.mark.parametrize("keyver", [1, 2, 3])
+def test_eapol_exact(keyver):
+    psk = b"hunter2hunter2"
+    line = tfx.make_eapol_line(psk, b"MyWifi", keyver=keyver, seed=f"kv{keyver}")
+    f = crack_one(line, psk)
+    assert f.nc == 0
+
+
+@pytest.mark.parametrize("delta,endian", [(3, "LE"), (-2, "BE")])
+def test_eapol_nonce_error_correction(delta, endian):
+    psk = b"correcthorse"
+    line = tfx.make_eapol_line(
+        psk, b"NCNet", keyver=2, nc_delta=delta, endian=endian, seed=f"nc{delta}{endian}"
+    )
+    f = crack_one(line, psk)
+    assert (f.nc, f.endian) == (delta, endian)
+    o = oracle.check_key_m22000(line, [psk])
+    assert (o[1], o[2]) == (delta, endian)
+
+
+def test_apless_message_pair_skips_nc():
+    psk = b"exactonly1"
+    # ap-less: nonce taken from the AP's own M1, NC must not be searched
+    line = tfx.make_eapol_line(
+        psk, b"ApLess", keyver=2, message_pair=hl.MP_APLESS, seed="apless"
+    )
+    eng = M22000Engine([line], batch_size=BATCH)
+    assert len(eng.nets[0].variants) == 1
+    assert eng.crack(small_dict(psk))[0].psk == psk
+
+    # same net but NC-shifted: gated engine must NOT find it
+    shifted = tfx.make_eapol_line(
+        psk, b"ApLess", keyver=2, nc_delta=2, endian="LE",
+        message_pair=hl.MP_APLESS, seed="apless2",
+    )
+    shifted = shifted[:-2] + "10"  # keep only the ap-less bit (clear NC-needed)
+    eng2 = M22000Engine([shifted], batch_size=BATCH)
+    assert eng2.crack(small_dict(psk)) == []
+
+
+def test_endian_gating_bits():
+    psk = b"legatedpass"
+    line = tfx.make_eapol_line(
+        psk, b"LeNet", keyver=2, nc_delta=1, endian="LE",
+        message_pair=hl.MP_LE, seed="gate-le",
+    )
+    eng = M22000Engine([line], batch_size=BATCH)
+    # LE-gated: every non-exact variant must be LE
+    assert all(e == "LE" for d, e in eng.nets[0].variants if d != 0)
+    assert eng.crack(small_dict(psk))[0].nc == 1
+
+
+def test_essid_grouping_multi_net():
+    essid = b"SharedESSID"
+    psk1, psk2 = b"password-one", b"password-two"
+    lines = [
+        tfx.make_eapol_line(psk1, essid, keyver=2, seed="g1"),
+        tfx.make_eapol_line(psk2, essid, keyver=2, seed="g2"),
+        tfx.make_pmkid_line(psk1, essid, seed="g3"),
+    ]
+    eng = M22000Engine(lines, batch_size=BATCH)
+    assert len(eng.groups) == 1  # one PBKDF2 pass serves all three nets
+    founds = eng.crack(small_dict(psk1, psk2))
+    assert sorted(f.psk for f in founds) == sorted([psk1, psk1, psk2])
+    assert not eng.groups  # all nets cracked and retired
+
+
+def test_wrong_passwords_find_nothing():
+    line = tfx.make_eapol_line(b"rightpass99", b"NoNet", keyver=2, seed="none")
+    eng = M22000Engine([line], batch_size=BATCH)
+    assert eng.crack(small_dict()) == []
+
+
+def test_short_candidates_filtered():
+    psk = b"okpass88"
+    eng = M22000Engine([tfx.make_pmkid_line(psk, b"Len")], batch_size=BATCH)
+    founds = eng.crack([b"short", b"x" * 64, psk])
+    assert [f.psk for f in founds] == [psk]
