@@ -1,0 +1,43 @@
+"""CLI: ``python -m dwpa_tpu.client <server-url> [options]``.
+
+Flag set mirrors the reference client's argparse surface
+(help_crack.py:975-990): ``-ad`` additional dictionary, ``-pot`` potfile
+path, plus engine knobs.
+"""
+
+import argparse
+
+from .main import ClientConfig, TpuCrackClient
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="dwpa_tpu.client",
+        description="dwpa volunteer client with a JAX/TPU m22000 cracker",
+    )
+    p.add_argument("base_url", help="dwpa server base URL (e.g. https://wpa-sec.example/)")
+    p.add_argument("-ad", "--additional-dict", help="extra local dictionary (pass 1)")
+    p.add_argument("-pot", "--potfile", help="potfile path for founds")
+    p.add_argument("-w", "--workdir", default="hc_work", help="working directory")
+    p.add_argument("-d", "--dictcount", type=int, default=1, help="initial dict count 1..15")
+    p.add_argument("-b", "--batch-size", type=int, default=16384, help="device batch size")
+    p.add_argument("-n", "--max-work-units", type=int, default=0, help="stop after N units")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    cfg = ClientConfig(
+        base_url=args.base_url,
+        workdir=args.workdir,
+        dictcount=args.dictcount,
+        batch_size=args.batch_size,
+        additional_dict=args.additional_dict,
+        potfile=args.potfile,
+        max_work_units=args.max_work_units,
+    )
+    TpuCrackClient(cfg).run()
+
+
+if __name__ == "__main__":
+    main()

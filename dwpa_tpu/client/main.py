@@ -1,0 +1,228 @@
+"""The volunteer client main loop, with the TPU engine as the cracker.
+
+Equivalent of the reference client's fetch->crack->submit loop
+(help_crack.py run(), :881-957), redesigned around the on-device engine:
+
+- challenge gate: before any work is fetched, the engine must crack a
+  synthesized known-PSK PMKID + EAPOL pair (the reference uses hardcoded
+  vectors, help_crack.py:690-725; we generate ours from the oracle, which
+  additionally proves oracle/device agreement end-to-end);
+- work loop: get_work -> resume snapshot -> dict download (md5-checked,
+  cached by dhash) -> two-pass crack (pass 1: targeted candidates from the
+  hash material + dynamic PR dict, no rules — mirroring the DAW client's
+  testtarget/prdict flow, help_crack.py:615-665; pass 2: server dicts
+  expanded through the server-supplied hashcat rules) -> put_work;
+- dictcount autotune +/-1 against the 900 s work-unit pacing target,
+  clamped 1..15 (help_crack.py:947-952, get_work.php:41-46);
+- resume file: a JSON snapshot of the work unit written before cracking
+  and replayed on restart (help_crack.py:737-763);
+- potfile: founds appended as ``<hashline>:<psk>`` for user tooling.
+"""
+
+import base64
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from ..gen import DictStream, psk_candidates
+from ..models import hashline as hl
+from ..models.m22000 import M22000Engine
+from ..rules import apply_rules, parse_rules
+from .. import testing as synth
+from ..oracle import m22000 as oracle
+from .protocol import NoNets, ServerAPI
+
+PACE_TARGET_S = 900.0  # work-unit pacing target (reference autotune threshold)
+CHALLENGE_PSK = b"aaaa1234"
+
+
+@dataclass
+class ClientConfig:
+    base_url: str
+    workdir: str = "hc_work"
+    dictcount: int = 1
+    batch_size: int = 16384
+    additional_dict: str = None     # -ad equivalent
+    potfile: str = None             # -pot equivalent (default: workdir/potfile)
+    nc: int = 8
+    max_work_units: int = 0         # 0 = run forever
+    pace_target: float = PACE_TARGET_S
+
+
+@dataclass
+class WorkResult:
+    hkey: str
+    founds: list
+    elapsed: float
+    accepted: bool = False
+    candidates_tried: int = 0
+
+
+class TpuCrackClient:
+    def __init__(self, config: ClientConfig, api: ServerAPI = None, log=print):
+        self.cfg = config
+        self.api = api or ServerAPI(config.base_url)
+        self.log = log
+        os.makedirs(config.workdir, exist_ok=True)
+        self.dictdir = os.path.join(config.workdir, "dicts")
+        os.makedirs(self.dictdir, exist_ok=True)
+        self.resume_path = os.path.join(config.workdir, "resume.json")
+        self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
+        self.dictcount = max(1, min(15, config.dictcount))
+
+    # -- challenge gate ----------------------------------------------------
+
+    def challenge(self) -> bool:
+        """Known-PSK self-test; any failure disqualifies this cracker."""
+        lines = [
+            synth.make_pmkid_line(CHALLENGE_PSK, b"dlink", seed="challenge-p"),
+            synth.make_eapol_line(CHALLENGE_PSK, b"dlink", keyver=2, seed="challenge-e"),
+        ]
+        eng = M22000Engine(lines, nc=self.cfg.nc, batch_size=64)
+        words = [b"notit%04d" % i for i in range(63)] + [CHALLENGE_PSK]
+        founds = eng.crack(words)
+        ok = len(founds) == 2 and all(f.psk == CHALLENGE_PSK for f in founds)
+        self.log(f"challenge: {'passed' if ok else 'FAILED'}")
+        return ok
+
+    # -- work-unit plumbing ------------------------------------------------
+
+    def _write_resume(self, work: dict):
+        with open(self.resume_path, "w") as f:
+            json.dump(work, f)
+
+    def _clear_resume(self):
+        if os.path.exists(self.resume_path):
+            os.unlink(self.resume_path)
+
+    def _read_resume(self) -> dict:
+        if not os.path.exists(self.resume_path):
+            return None
+        try:
+            with open(self.resume_path) as f:
+                work = json.load(f)
+            if "hkey" in work and "hashes" in work and "dicts" in work:
+                return work
+        except (ValueError, OSError):
+            pass
+        self._clear_resume()
+        return None
+
+    def _fetch_dicts(self, work: dict) -> list:
+        """Download (or reuse cached) work dicts; returns local paths."""
+        paths = []
+        for d in work.get("dicts", []):
+            dest = os.path.join(self.dictdir, d["dhash"] + ".gz")
+            if not os.path.exists(dest):
+                self.api.download(d["dpath"], dest, expected_md5=d["dhash"])
+            paths.append(dest)
+        return paths
+
+    def _rules(self, work: dict):
+        blob = work.get("rules")
+        if not blob:
+            return []
+        try:
+            text = base64.b64decode(blob).decode("utf-8", "replace")
+        except ValueError:
+            return []
+        return parse_rules(text.splitlines())
+
+    def _targeted_candidates(self, engine: M22000Engine, work: dict):
+        """Pass-1 generator: hash-material candidates + dynamic PR dict."""
+        for net in engine.nets:
+            yield from psk_candidates(
+                net.line.essid, net.line.mac_ap, net.line.mac_sta
+            )
+        if work.get("prdict"):
+            try:
+                for w in self.api.get_prdict(work["hkey"]):
+                    yield oracle.hc_unhex(w)
+            except (ConnectionError, ValueError):
+                pass
+        if self.cfg.additional_dict:
+            yield from DictStream(self.cfg.additional_dict)
+
+    def _record_founds(self, founds: list):
+        with open(self.potfile, "a") as f:
+            for fd in founds:
+                f.write(f"{fd.line.raw}:{fd.psk.decode('latin1')}\n")
+
+    # -- the loop ----------------------------------------------------------
+
+    def process_work(self, work: dict) -> WorkResult:
+        t0 = time.time()
+        self._write_resume(work)
+        engine = M22000Engine(
+            work["hashes"], nc=self.cfg.nc, batch_size=self.cfg.batch_size
+        )
+        founds = []
+        tried = 0
+
+        def run_pass(candidates):
+            nonlocal tried
+            batch = []
+            for pw in candidates:
+                if not engine.groups:
+                    return
+                batch.append(pw)
+                if len(batch) == engine.batch_size:
+                    tried += len(batch)
+                    founds.extend(engine.crack_batch(batch))
+                    batch = []
+            if batch and engine.groups:
+                tried += len(batch)
+                founds.extend(engine.crack_batch(batch))
+
+        # pass 1: targeted, no rules
+        run_pass(self._targeted_candidates(engine, work))
+        # pass 2: server dicts through server rules
+        rules = self._rules(work)
+        for path in self._fetch_dicts(work):
+            stream = DictStream(path)
+            run_pass(apply_rules(rules, stream) if rules else stream)
+
+        elapsed = time.time() - t0
+        result = WorkResult(
+            hkey=work["hkey"], founds=founds, elapsed=elapsed,
+            candidates_tried=tried,
+        )
+        if founds:
+            self._record_founds(founds)
+        cand = [
+            {"k": f.line.mac_ap.hex(), "v": f.psk.hex()} for f in founds
+        ]
+        result.accepted = self.api.put_work(work["hkey"], cand)
+        self._clear_resume()
+        self._autotune(elapsed)
+        return result
+
+    def _autotune(self, elapsed: float):
+        if elapsed < self.cfg.pace_target and self.dictcount < 15:
+            self.dictcount += 1
+        elif elapsed > self.cfg.pace_target and self.dictcount > 1:
+            self.dictcount -= 1
+
+    def run(self) -> int:
+        """Challenge-gate then loop work units; returns units processed."""
+        if not self.challenge():
+            raise SystemExit("challenge failed: cracker output untrusted")
+        done = 0
+        while not self.cfg.max_work_units or done < self.cfg.max_work_units:
+            work = self._read_resume()
+            if work is None:
+                try:
+                    work = self.api.get_work(self.dictcount)
+                except NoNets:
+                    self.log("no nets available; sleeping")
+                    self.api.sleep(self.api.backoff)
+                    continue
+            res = self.process_work(work)
+            done += 1
+            self.log(
+                f"work {res.hkey[:8]}: {len(res.founds)} founds / "
+                f"{res.candidates_tried} candidates in {res.elapsed:.0f}s "
+                f"(accepted={res.accepted}, dictcount->{self.dictcount})"
+            )
+        return done

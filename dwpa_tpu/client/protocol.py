@@ -1,0 +1,119 @@
+"""HTTP/JSON transport for the dwpa volunteer protocol.
+
+Speaks the exact wire protocol of the reference server so this client can
+work against an unmodified dwpa deployment (endpoints and schemas per the
+reference: ?get_work / ?put_work / ?prdict routing at web/index.php:146-163,
+request/response shapes at web/content/get_work.php and
+web/content/put_work.php; client-side counterpart help_crack.py:404-426,
+727-735):
+
+- ``get_work``: POST ``{"dictcount": N}`` to ``?get_work=<api-ver>`` ->
+  ``{hkey, dicts:[{dhash,dpath}...], hashes:[...], rules?, prdict?}``;
+  sentinel body ``Version`` (client too old) or ``No nets``.
+- ``put_work``: POST ``{"hkey":…, "type":"bssid", "cand":[{k,v}...]}`` to
+  ``?put_work`` -> ``OK`` / anything else = rejected.
+- ``prdict``: GET ``?prdict=<hkey>`` -> gzip dictionary stream.
+- static artifacts (dicts) by URL with md5 manifests.
+
+Retry behavior mirrors the reference client: every network op retries with
+a backoff sleep (help_crack.py:80-87,104-126), except ``max_tries`` is
+configurable so tests and batch runs can fail fast instead of spinning
+forever.
+"""
+
+import gzip
+import hashlib
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+HC_VER = "2.2.0"  # protocol level spoken (server gates on MIN_HC_VER)
+
+
+class VersionRejected(RuntimeError):
+    """Server refused our protocol version."""
+
+
+class NoNets(RuntimeError):
+    """Server has no work to hand out."""
+
+
+class ServerAPI:
+    def __init__(self, base_url: str, hc_ver: str = HC_VER, timeout: float = 120.0,
+                 max_tries: int = 0, backoff: float = 123.0, sleep=time.sleep):
+        self.base_url = base_url.rstrip("/") + "/"
+        self.hc_ver = hc_ver
+        self.timeout = timeout
+        self.max_tries = max_tries  # 0 = retry forever (reference behavior)
+        self.backoff = backoff
+        self.sleep = sleep
+
+    # -- low level ---------------------------------------------------------
+
+    def fetch(self, url: str, data: dict = None) -> bytes:
+        """GET (or POST json) with retry/backoff."""
+        tries = 0
+        body = None
+        headers = {}
+        if data is not None:
+            body = json.dumps(data).encode()
+            headers["Content-Type"] = "application/json"
+        while True:
+            tries += 1
+            try:
+                req = urllib.request.Request(url, data=body, headers=headers)
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return r.read()
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                if self.max_tries and tries >= self.max_tries:
+                    raise ConnectionError(f"giving up on {url}: {e}") from e
+                self.sleep(self.backoff)
+
+    def _endpoint(self, query: str) -> str:
+        return self.base_url + "?" + query
+
+    # -- protocol ops ------------------------------------------------------
+
+    def get_work(self, dictcount: int) -> dict:
+        raw = self.fetch(
+            self._endpoint("get_work=" + self.hc_ver), {"dictcount": dictcount}
+        )
+        text = raw.decode("utf-8", "replace").strip()
+        if text == "Version":
+            raise VersionRejected(f"server requires newer client than {self.hc_ver}")
+        if text == "No nets":
+            raise NoNets()
+        work = json.loads(raw)
+        for field in ("hkey", "dicts", "hashes"):
+            if field not in work:
+                raise ValueError(f"malformed work unit: missing {field}")
+        return work
+
+    def put_work(self, hkey: str, candidates: list) -> bool:
+        """``candidates``: [{"k": bssid-12hex, "v": psk-hex}, ...]."""
+        raw = self.fetch(
+            self._endpoint("put_work"),
+            {"hkey": hkey, "type": "bssid", "cand": candidates},
+        )
+        return raw.decode("utf-8", "replace").strip() == "OK"
+
+    def get_prdict(self, hkey: str) -> list:
+        """Fetch + gunzip the dynamic PROBEREQUEST dictionary."""
+        raw = self.fetch(self._endpoint("prdict=" + urllib.parse.quote(hkey)))
+        if raw[:2] == b"\x1f\x8b":
+            raw = gzip.decompress(raw)
+        return [w for w in raw.split(b"\n") if w]
+
+    def download(self, url: str, dest: str, expected_md5: str = None) -> str:
+        if not urllib.parse.urlparse(url).scheme:
+            url = urllib.parse.urljoin(self.base_url, url)
+        data = self.fetch(url)
+        if expected_md5 is not None:
+            got = hashlib.md5(data).hexdigest()
+            if got != expected_md5:
+                raise ValueError(f"md5 mismatch for {url}: {got} != {expected_md5}")
+        with open(dest, "wb") as f:
+            f.write(data)
+        return dest

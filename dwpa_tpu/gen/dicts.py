@@ -1,0 +1,74 @@
+"""Streaming wordlist reading (plain or gzip) with md5 integrity checks.
+
+Mirrors the reference client's dictionary handling: dicts arrive as
+``.txt.gz`` files whose md5 must match the server's ``dicts.dhash``
+(help_crack/help_crack.py:533-534); words are one candidate per line.
+Reading is chunked so multi-GB dictionaries never fully materialize —
+the host stays ahead of the device by yielding fixed-size batches.
+"""
+
+import gzip
+import hashlib
+import io
+
+
+def md5_file(path: str, chunk: int = 1 << 20) -> str:
+    """Hex md5 of a file (the reference's dict integrity check)."""
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return h.hexdigest()
+            h.update(b)
+
+
+class DictStream:
+    """Iterate candidate byte-strings from a wordlist file or fileobj.
+
+    Transparently gunzips (by magic, like the reference's valid_cap gz
+    handling, web/common.php:454-456).  Strips line endings only; interior
+    whitespace is significant.  ``skip``/``limit`` support keyspace
+    slicing for resume.
+    """
+
+    def __init__(self, source, skip: int = 0, limit: int = None):
+        self.source = source
+        self.skip = skip
+        self.limit = limit
+
+    def _open(self):
+        if isinstance(self.source, (str, bytes)):
+            f = open(self.source, "rb")
+        else:
+            f = self.source
+        head = f.peek(2) if hasattr(f, "peek") else b""
+        if isinstance(f, io.BufferedReader) and head[:2] == b"\x1f\x8b":
+            return gzip.open(f)
+        if isinstance(self.source, (str, bytes)) and str(self.source).endswith(".gz"):
+            return gzip.open(f)
+        return f
+
+    def __iter__(self):
+        n = 0
+        with self._open() as f:
+            for i, line in enumerate(f):
+                if i < self.skip:
+                    continue
+                if self.limit is not None and n >= self.limit:
+                    return
+                word = line.rstrip(b"\r\n")
+                if word:
+                    n += 1
+                    yield word
+
+    def batches(self, size: int):
+        """Yield lists of up to ``size`` words."""
+        batch = []
+        for w in self:
+            batch.append(w)
+            if len(batch) == size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch

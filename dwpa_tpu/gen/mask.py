@@ -1,0 +1,69 @@
+"""Hashcat mask attack generator.
+
+Pure-host enumeration of ``?d?l?u?s?a?b?h?H`` masks with literals — e.g.
+``?d?d?d?d?d?d?d?d`` is the 8-digit brute sweep tracked as BASELINE.json
+config #5.  The generator yields in hashcat's positional order (last
+position fastest) so keyspace slices (skip/limit) line up with hashcat's
+``-s``/``-l`` semantics for resume.
+"""
+
+import string
+
+CHARSETS = {
+    "l": string.ascii_lowercase.encode(),
+    "u": string.ascii_uppercase.encode(),
+    "d": string.digits.encode(),
+    "s": b" !\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~",
+    "h": b"0123456789abcdef",
+    "H": b"0123456789ABCDEF",
+}
+CHARSETS["a"] = CHARSETS["l"] + CHARSETS["u"] + CHARSETS["d"] + CHARSETS["s"]
+CHARSETS["b"] = bytes(range(256))
+
+
+def parse_mask(mask: str, custom: dict = None):
+    """Mask string -> list of per-position byte alphabets."""
+    custom = custom or {}
+    out = []
+    i = 0
+    while i < len(mask):
+        c = mask[i]
+        if c == "?":
+            if i + 1 >= len(mask):
+                raise ValueError("dangling '?' in mask")
+            key = mask[i + 1]
+            if key == "?":
+                out.append(b"?")
+            elif key in "1234":
+                out.append(custom[key])
+            elif key in CHARSETS:
+                out.append(CHARSETS[key])
+            else:
+                raise ValueError(f"unknown mask charset ?{key}")
+            i += 2
+        else:
+            out.append(c.encode("latin1"))
+            i += 1
+    return out
+
+
+def mask_keyspace(mask: str, custom: dict = None) -> int:
+    n = 1
+    for alpha in parse_mask(mask, custom):
+        n *= len(alpha)
+    return n
+
+
+def mask_words(mask: str, custom: dict = None, skip: int = 0, limit: int = None):
+    """Yield mask words; ``skip``/``limit`` slice the keyspace for resume."""
+    alphas = parse_mask(mask, custom)
+    total = mask_keyspace(mask, custom)
+    end = total if limit is None else min(total, skip + limit)
+    sizes = [len(a) for a in alphas]
+    for idx in range(skip, end):
+        word = bytearray(len(alphas))
+        rem = idx
+        for p in range(len(alphas) - 1, -1, -1):
+            rem, d = divmod(rem, sizes[p])
+            word[p] = alphas[p][d]
+        yield bytes(word)

@@ -29,15 +29,17 @@ TPU-first design:
 
 import struct
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops import hmac as hm
-from ..ops.aes import aes128_cmac
+from ..ops.aes import aes128_cmac_rolled
 from ..ops.common import bswap32, u32
+from ..ops.md5 import md5_compress_rolled
+from ..ops.sha1 import sha1_compress_rolled
+from ..ops.sha256 import sha256_compress_rolled
 from ..ops.pbkdf2 import pbkdf2_sha1_pmk
 from ..oracle import m22000 as oracle
 from ..utils import bytesops as bo
@@ -212,8 +214,12 @@ def _eq4(out, target):
 
 def _pmkid_impl(pmk, msg_block, target):
     shape = pmk.shape[1:]
-    ist, ost = hm.hmac_sha1_precompute(_pmk_key_block(pmk), shape)
-    out = hm.hmac_sha1_blocks(ist, ost, [[msg_block[i] for i in range(16)]])
+    ist, ost = hm.hmac_sha1_precompute(
+        _pmk_key_block(pmk), shape, compress=sha1_compress_rolled
+    )
+    out = hm.hmac_sha1_blocks(
+        ist, ost, [[msg_block[i] for i in range(16)]], compress=sha1_compress_rolled
+    )
     return _eq4(out, target)
 
 
@@ -221,8 +227,7 @@ def _pmkid_impl(pmk, msg_block, target):
 pmkid_kernel = jax.jit(_pmkid_impl)
 
 
-@partial(jax.jit, static_argnames=("keyver",))
-def eapol_kernel(pmk, prf_blocks, eapol_blocks, target, *, keyver):
+def eapol_match(pmk, prf_blocks, eapol_blocks, target, *, keyver):
     """MIC match for keyver 1/2 over all NC variants.
 
     ``pmk``: uint32[8, B]; ``prf_blocks``: uint32[V, 2, 16];
@@ -230,35 +235,41 @@ def eapol_kernel(pmk, prf_blocks, eapol_blocks, target, *, keyver):
     Returns bool[V, B].
     """
     shape = pmk.shape[1:]
-    ist, ost = hm.hmac_sha1_precompute(_pmk_key_block(pmk), shape)
+    ist, ost = hm.hmac_sha1_precompute(
+        _pmk_key_block(pmk), shape, compress=sha1_compress_rolled
+    )
     eap = _rows(eapol_blocks)
 
     def per_variant(blk2):
-        prf = hm.hmac_sha1_blocks(ist, ost, _rows(blk2, 2))
+        prf = hm.hmac_sha1_blocks(ist, ost, _rows(blk2, 2), compress=sha1_compress_rolled)
         kck = list(prf[:4])
         if keyver == 1:
             kb = [bswap32(w) for w in kck] + [0] * 12
-            ii, oo = hm.hmac_md5_precompute(kb, shape)
-            out = hm.hmac_md5_blocks(ii, oo, eap)
+            ii, oo = hm.hmac_md5_precompute(kb, shape, compress=md5_compress_rolled)
+            out = hm.hmac_md5_blocks(ii, oo, eap, compress=md5_compress_rolled)
         else:
             kb = kck + [0] * 12
-            ii, oo = hm.hmac_sha1_precompute(kb, shape)
-            out = hm.hmac_sha1_blocks(ii, oo, eap)
+            ii, oo = hm.hmac_sha1_precompute(kb, shape, compress=sha1_compress_rolled)
+            out = hm.hmac_sha1_blocks(ii, oo, eap, compress=sha1_compress_rolled)
         return _eq4(out, target)
 
     return jax.vmap(per_variant)(prf_blocks)
 
 
-@partial(jax.jit, static_argnames=("last_complete",))
-def eapol_cmac_kernel(pmk, prf_blocks, cmac_full, cmac_last, target, *, last_complete):
+eapol_kernel = jax.jit(eapol_match, static_argnames=("keyver",))
+
+
+def eapol_cmac_match(pmk, prf_blocks, cmac_full, cmac_last, target, *, last_complete):
     """AES-128-CMAC MIC match (keyver 3, WPA2 802.11w) -> bool[V, B]."""
     shape = pmk.shape[1:]
-    ist, ost = hm.hmac_sha256_precompute(_pmk_key_block(pmk), shape)
-    full = _rows(cmac_full) if cmac_full.shape[0] else []
-    last = [cmac_last[i] for i in range(16)]
+    ist, ost = hm.hmac_sha256_precompute(
+        _pmk_key_block(pmk), shape, compress=sha256_compress_rolled
+    )
 
     def per_variant(blk2):
-        prf = hm.hmac_sha256_blocks(ist, ost, _rows(blk2, 2))
+        prf = hm.hmac_sha256_blocks(
+            ist, ost, _rows(blk2, 2), compress=sha256_compress_rolled
+        )
         kck_bytes = []
         for w in prf[:4]:
             kck_bytes += [
@@ -267,13 +278,38 @@ def eapol_cmac_kernel(pmk, prf_blocks, cmac_full, cmac_last, target, *, last_com
                 (w >> 8) & u32(0xFF),
                 w & u32(0xFF),
             ]
-        mac = aes128_cmac(kck_bytes, full, last, last_complete)
-        m = mac[0] == target[0]
-        for i in range(1, 16):
-            m = m & (mac[i] == target[i])
-        return m
+        mac = aes128_cmac_rolled(
+            jnp.stack(kck_bytes), cmac_full, cmac_last, last_complete
+        )
+        return jnp.all(mac == target[:, None], axis=0)
 
     return jax.vmap(per_variant)(prf_blocks)
+
+
+eapol_cmac_kernel = jax.jit(eapol_cmac_match, static_argnames=("last_complete",))
+
+
+def net_match(pmk, net: PreppedNet):
+    """Trace-time dispatch of one prepped net -> bool[V, B] (composable)."""
+    if net.keyver == 100:
+        m = _pmkid_impl(pmk, jnp.asarray(net.pmkid_block), jnp.asarray(net.target))
+        return m[None, :]
+    if net.keyver == 3:
+        return eapol_cmac_match(
+            pmk,
+            jnp.asarray(net.prf_blocks),
+            jnp.asarray(net.cmac_full),
+            jnp.asarray(net.cmac_last),
+            jnp.asarray(net.cmac_target),
+            last_complete=net.cmac_last_complete,
+        )
+    return eapol_match(
+        pmk,
+        jnp.asarray(net.prf_blocks),
+        jnp.asarray(net.eapol_blocks),
+        jnp.asarray(net.target),
+        keyver=net.keyver,
+    )
 
 
 def verify_net(pmk, net: PreppedNet):

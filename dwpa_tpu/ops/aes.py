@@ -53,18 +53,12 @@ def _make_sbox() -> np.ndarray:
 
 SBOX = _make_sbox()
 RCON = [1, 2, 4, 8, 16, 32, 64, 128, 27, 54]
-_SBOX_DEV = None
-
-
-def _sbox_dev():
-    global _SBOX_DEV
-    if _SBOX_DEV is None:
-        _SBOX_DEV = jnp.asarray(SBOX)
-    return _SBOX_DEV
 
 
 def _sub(byte_arr):
-    return jnp.take(_sbox_dev(), byte_arr.astype(jnp.int32))
+    # jnp.asarray of a host constant folds to an XLA constant per trace;
+    # caching the device array globally would leak tracers across traces.
+    return jnp.take(jnp.asarray(SBOX), byte_arr.astype(jnp.int32))
 
 
 def _xtime(b):
@@ -115,6 +109,90 @@ def aes128_encrypt_block(round_keys, block16):
             s = ns
         s = [s[i] ^ u32(round_keys[r][i]) for i in range(16)]
     return s
+
+
+# ---------------------------------------------------------------------------
+# Rolled array-state variant (cold-path compile-time trade, like
+# sha1_compress_rolled): state is ONE uint32[16, ...] array, rounds are a
+# fori_loop, SubBytes one gather, ShiftRows a constant permutation.
+# ---------------------------------------------------------------------------
+
+import jax
+
+_SHIFT_ROWS = np.array([(i + 4 * (i % 4)) % 16 for i in range(16)])
+_ROT_WORD = np.array([13, 14, 15, 12])
+
+
+def _mix_columns_arr(s):
+    a = s.reshape((4, 4) + s.shape[1:])
+    x = _xtime(a)
+    rows = [
+        x[:, 0] ^ x[:, 1] ^ a[:, 1] ^ a[:, 2] ^ a[:, 3],
+        a[:, 0] ^ x[:, 1] ^ x[:, 2] ^ a[:, 2] ^ a[:, 3],
+        a[:, 0] ^ a[:, 1] ^ x[:, 2] ^ x[:, 3] ^ a[:, 3],
+        x[:, 0] ^ a[:, 0] ^ a[:, 1] ^ a[:, 2] ^ x[:, 3],
+    ]
+    return jnp.stack(rows, axis=1).reshape(s.shape)
+
+
+def aes128_expand_key_rolled(key16):
+    """key16: uint32[16, ...] byte-value array -> uint32[11, 16, ...]."""
+    rcon = jnp.asarray(RCON, dtype=jnp.uint32)
+
+    def body(prev, rc):
+        t = _sub(prev[_ROT_WORD])
+        t = t.at[0].set(t[0] ^ rc)
+        words = []
+        cur = t
+        for c in range(4):
+            cur = prev[4 * c : 4 * c + 4] ^ cur
+            words.append(cur)
+        nk = jnp.concatenate(words)
+        return nk, nk
+
+    _, rks = jax.lax.scan(body, key16, rcon)
+    return jnp.concatenate([key16[None], rks])
+
+
+def aes128_encrypt_rolled(rks, block):
+    """``rks``: uint32[11, 16, ...]; ``block``: uint32[16, ...]."""
+    s = block ^ rks[0]
+
+    def round_body(r, s):
+        s = _sub(s)[_SHIFT_ROWS]
+        s = _mix_columns_arr(s)
+        return s ^ rks[r]
+
+    s = jax.lax.fori_loop(1, 10, round_body, s)
+    s = _sub(s)[_SHIFT_ROWS]
+    return s ^ rks[10]
+
+
+def _dbl_arr(b):
+    carry = jnp.concatenate([b[1:] >> 7, jnp.zeros_like(b[:1])])
+    out = ((b << 1) & u32(0xFF)) | carry
+    return out.at[15].set(out[15] ^ (b[0] >> 7) * u32(0x87))
+
+
+def aes128_cmac_rolled(key16, msg_blocks, last_block, last_complete):
+    """AES-128-CMAC with the rolled AES core.
+
+    ``key16``: uint32[16, ...] (batched KCK bytes); ``msg_blocks``:
+    uint32[F, 16] constants; ``last_block``: uint32[16] (10*-padded if
+    incomplete); ``last_complete``: static bool.  Returns uint32[16, ...].
+    """
+    rks = aes128_expand_key_rolled(key16)
+    shape = key16.shape[1:]
+    zero = jnp.zeros((16,) + shape, dtype=jnp.uint32)
+    k1 = _dbl_arr(aes128_encrypt_rolled(rks, zero))
+    sub = k1 if last_complete else _dbl_arr(k1)
+
+    c = zero
+    for i in range(msg_blocks.shape[0]):
+        blk = jnp.broadcast_to(msg_blocks[i][(...,) + (None,) * len(shape)], c.shape)
+        c = aes128_encrypt_rolled(rks, blk ^ c)
+    last = jnp.broadcast_to(last_block[(...,) + (None,) * len(shape)], c.shape)
+    return aes128_encrypt_rolled(rks, last ^ sub ^ c)
 
 
 def _dbl(b16):

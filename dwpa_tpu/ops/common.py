@@ -24,6 +24,12 @@ def rotl32(x, n: int):
     return (x << n) | (x >> (32 - n))
 
 
+def rotl32_dyn(x, n):
+    """Rotate uint32 left by a traced per-element amount ``0 < n < 32``."""
+    n = jnp.uint32(n)
+    return (x << n) | (x >> (jnp.uint32(32) - n))
+
+
 def rotr32(x, n: int):
     """Rotate a uint32 array right by a static amount ``0 < n < 32``."""
     return (x >> n) | (x << (32 - n))

@@ -11,6 +11,12 @@ Message blocks arriving here must already be padded (host-side, see
 utils/bytesops.padded_blocks) with total length accounting for the 64-byte
 key block.  Word entries may be Python ints (constants, folded by XLA) or
 uint32 arrays broadcast against the batch.
+
+Every function takes an optional ``compress`` argument selecting the
+compression implementation: the default unrolled form (best TPU runtime,
+used by the PBKDF2 hot loop) or the ``*_compress_rolled`` variants (tiny
+XLA graphs, used by the cold verification kernels where XLA:CPU's compile
+time on unrolled straight-line code is prohibitive).
 """
 
 from .common import u32
@@ -26,65 +32,65 @@ def _xor_block(key_block, pad):
     return [u32(w) ^ u32(pad) for w in key_block]
 
 
-def hmac_sha1_precompute(key_block, shape=()):
+def hmac_sha1_precompute(key_block, shape=(), compress=sha1_compress):
     """key_block: 16 uint32 words (zero-padded key). -> (istate, ostate)."""
-    i = sha1_compress(sha1_init(shape), _xor_block(key_block, IPAD))
-    o = sha1_compress(sha1_init(shape), _xor_block(key_block, OPAD))
+    i = compress(sha1_init(shape), _xor_block(key_block, IPAD))
+    o = compress(sha1_init(shape), _xor_block(key_block, OPAD))
     return i, o
 
 
-def hmac_md5_precompute(key_block, shape=()):
-    i = md5_compress(md5_init(shape), _xor_block(key_block, IPAD))
-    o = md5_compress(md5_init(shape), _xor_block(key_block, OPAD))
+def hmac_md5_precompute(key_block, shape=(), compress=md5_compress):
+    i = compress(md5_init(shape), _xor_block(key_block, IPAD))
+    o = compress(md5_init(shape), _xor_block(key_block, OPAD))
     return i, o
 
 
-def hmac_sha256_precompute(key_block, shape=()):
-    i = sha256_compress(sha256_init(shape), _xor_block(key_block, IPAD))
-    o = sha256_compress(sha256_init(shape), _xor_block(key_block, OPAD))
+def hmac_sha256_precompute(key_block, shape=(), compress=sha256_compress):
+    i = compress(sha256_init(shape), _xor_block(key_block, IPAD))
+    o = compress(sha256_init(shape), _xor_block(key_block, OPAD))
     return i, o
 
 
-def _outer_sha1(ostate, inner_digest):
+def _outer_sha1(ostate, inner_digest, compress=sha1_compress):
     # outer message = 20-byte digest; total hashed = 64 (key) + 20 = 84 bytes
     blk = list(inner_digest) + [0x80000000] + [0] * 9 + [84 * 8]
-    return sha1_compress(ostate, blk)
+    return compress(ostate, blk)
 
 
-def hmac_sha1_20(istate, ostate, m5):
+def hmac_sha1_20(istate, ostate, m5, compress=sha1_compress):
     """HMAC-SHA1 of a 20-byte message given precomputed pad states.
 
     The PBKDF2 iteration shape: exactly two compressions.
     ``m5``: 5 uint32 word arrays.
     """
     blk = list(m5) + [0x80000000] + [0] * 9 + [84 * 8]
-    inner = sha1_compress(istate, blk)
-    return _outer_sha1(ostate, inner)
+    inner = compress(istate, blk)
+    return _outer_sha1(ostate, inner, compress)
 
 
-def hmac_sha1_blocks(istate, ostate, msg_blocks):
+def hmac_sha1_blocks(istate, ostate, msg_blocks, compress=sha1_compress):
     """HMAC-SHA1 over pre-padded message blocks (after the key block)."""
     st = istate
     for blk in msg_blocks:
-        st = sha1_compress(st, blk)
-    return _outer_sha1(ostate, st)
+        st = compress(st, blk)
+    return _outer_sha1(ostate, st, compress)
 
 
-def hmac_md5_blocks(istate, ostate, msg_blocks):
+def hmac_md5_blocks(istate, ostate, msg_blocks, compress=md5_compress):
     """HMAC-MD5 over pre-padded (little-endian word) message blocks."""
     st = istate
     for blk in msg_blocks:
-        st = md5_compress(st, blk)
+        st = compress(st, blk)
     # outer message = 16-byte digest (4 LE words); total = 64 + 16 = 80 bytes
     blk = list(st) + [0x80] + [0] * 9 + [80 * 8, 0]
-    return md5_compress(ostate, blk)
+    return compress(ostate, blk)
 
 
-def hmac_sha256_blocks(istate, ostate, msg_blocks):
+def hmac_sha256_blocks(istate, ostate, msg_blocks, compress=sha256_compress):
     """HMAC-SHA256 over pre-padded message blocks."""
     st = istate
     for blk in msg_blocks:
-        st = sha256_compress(st, blk)
+        st = compress(st, blk)
     # outer message = 32-byte digest; total = 64 + 32 = 96 bytes
     blk = list(st) + [0x80000000] + [0] * 6 + [96 * 8]
-    return sha256_compress(ostate, blk)
+    return compress(ostate, blk)

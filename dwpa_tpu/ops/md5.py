@@ -11,9 +11,11 @@ byte order (utils/bytesops), the compression here is byte-order agnostic.
 
 import math
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
-from .common import rotl32, u32
+from .common import rotl32, rotl32_dyn, u32
 
 IV = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
 
@@ -60,6 +62,48 @@ def md5_compress(state, block):
 
     s0, s1, s2, s3 = state
     return (s0 + a, s1 + b, s2 + c, s3 + d)
+
+
+# Message-word index per round (the g(i) schedule above, as a table).
+G = np.array(
+    [i for i in range(16)]
+    + [(5 * i + 1) % 16 for i in range(16, 32)]
+    + [(3 * i + 5) % 16 for i in range(32, 48)]
+    + [(7 * i) % 16 for i in range(48, 64)],
+    dtype=np.int32,
+)
+
+
+def md5_compress_rolled(state, block):
+    """One MD5 compression as a rolled ``fori_loop`` (cold-path variant).
+
+    Same trade as sha1_compress_rolled: tiny graph, fast compile; per-round
+    T/S/G constants become table lookups and the rotate amount is dynamic.
+    """
+    shape = jnp.broadcast_shapes(*(jnp.shape(u32(w)) for w in block), state[0].shape)
+    ws = jnp.stack([jnp.broadcast_to(u32(w), shape) for w in block])
+    t_arr = jnp.asarray(T, dtype=jnp.uint32)
+    s_arr = jnp.asarray(S, dtype=jnp.uint32)
+    g_arr = jnp.asarray(G)
+
+    def body(i, st):
+        a, b, c, d = st
+        f = jax.lax.switch(
+            i // 16,
+            [
+                lambda: (b & c) | (~b & d),
+                lambda: (d & b) | (~d & c),
+                lambda: b ^ c ^ d,
+                lambda: c ^ (b | ~d),
+            ],
+        )
+        nb = b + rotl32_dyn(a + f + t_arr[i] + ws[g_arr[i]], s_arr[i])
+        return (d, nb, b, c)
+
+    out = jax.lax.fori_loop(
+        0, 64, body, tuple(jnp.broadcast_to(s, shape) for s in state)
+    )
+    return tuple(s + o for s, o in zip(state, out))
 
 
 def md5_digest_blocks(blocks, shape=()):

@@ -11,6 +11,7 @@ compressions per candidate, so everything else in the framework is designed
 around keeping this function's operands in vector registers.
 """
 
+import jax
 import jax.numpy as jnp
 
 from .common import rotl32, u32
@@ -63,6 +64,45 @@ def sha1_compress(state, block):
 
     s0, s1, s2, s3, s4 = state
     return (s0 + a, s1 + b, s2 + c, s3 + d, s4 + e)
+
+
+def sha1_compress_rolled(state, block):
+    """One SHA-1 compression as a rolled ``fori_loop`` (tiny XLA graph).
+
+    Semantically identical to ``sha1_compress`` but trades straight-line
+    speed for compile time: the 80 rounds become one loop body and the
+    message schedule a 64-step scan.  Used on the *cold* verification path
+    (a handful of compressions per candidate), where XLA:CPU's LLVM
+    pipeline otherwise spends minutes on the unrolled graph; the PBKDF2
+    hot loop keeps the unrolled form.
+    """
+    shape = jnp.broadcast_shapes(*(jnp.shape(u32(w)) for w in block), state[0].shape)
+    ws = jnp.stack([jnp.broadcast_to(u32(w), shape) for w in block])
+
+    def sched(w16, _):
+        nw = rotl32(w16[13] ^ w16[8] ^ w16[2] ^ w16[0], 1)
+        return jnp.concatenate([w16[1:], nw[None]]), nw
+
+    _, tail = jax.lax.scan(sched, ws, None, length=64)
+    sched80 = jnp.concatenate([ws, tail])
+
+    def body(t, st):
+        a, b, c, d, e = st
+        stage = t // 20
+        fk = jax.lax.switch(
+            stage,
+            [
+                lambda: ((b & c) | (~b & d)) + u32(K0),
+                lambda: (b ^ c ^ d) + u32(K1),
+                lambda: ((b & c) | (b & d) | (c & d)) + u32(K2),
+                lambda: (b ^ c ^ d) + u32(K3),
+            ],
+        )
+        tmp = rotl32(a, 5) + fk + e + sched80[t]
+        return (tmp, a, rotl32(b, 30), c, d)
+
+    out = jax.lax.fori_loop(0, 80, body, tuple(jnp.broadcast_to(s, shape) for s in state))
+    return tuple(s + o for s, o in zip(state, out))
 
 
 def sha1_digest_blocks(blocks, shape=()):

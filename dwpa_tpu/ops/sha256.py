@@ -5,6 +5,7 @@ Used for the WPA2 802.11w keyver=3 PTK derivation
 Same unrolled word-list style as SHA-1.
 """
 
+import jax
 import jax.numpy as jnp
 
 from .common import rotr32, u32
@@ -63,6 +64,38 @@ def sha256_compress(state, block):
     s = state
     return (s[0] + a, s[1] + b, s[2] + c, s[3] + d,
             s[4] + e, s[5] + f, s[6] + g, s[7] + h)
+
+
+def sha256_compress_rolled(state, block):
+    """One SHA-256 compression as a rolled ``fori_loop`` (cold-path variant;
+    same compile-time trade as sha1_compress_rolled)."""
+    shape = jnp.broadcast_shapes(*(jnp.shape(u32(w)) for w in block), state[0].shape)
+    ws = jnp.stack([jnp.broadcast_to(u32(w), shape) for w in block])
+    k_arr = jnp.asarray(K, dtype=jnp.uint32)
+
+    def sched(w16, _):
+        w15, w2 = w16[1], w16[14]
+        s0 = rotr32(w15, 7) ^ rotr32(w15, 18) ^ (w15 >> 3)
+        s1 = rotr32(w2, 17) ^ rotr32(w2, 19) ^ (w2 >> 10)
+        nw = w16[0] + s0 + w16[9] + s1
+        return jnp.concatenate([w16[1:], nw[None]]), nw
+
+    _, tail = jax.lax.scan(sched, ws, None, length=48)
+    sched64 = jnp.concatenate([ws, tail])
+
+    def body(t, st):
+        a, b, c, d, e, f, g, h = st
+        S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + k_arr[t] + sched64[t]
+        S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + S0 + maj, a, b, c, d + t1, e, f, g)
+
+    out = jax.lax.fori_loop(
+        0, 64, body, tuple(jnp.broadcast_to(s, shape) for s in state)
+    )
+    return tuple(s + o for s, o in zip(state, out))
 
 
 def sha256_digest_blocks(blocks, shape=()):

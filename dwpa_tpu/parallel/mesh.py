@@ -1,0 +1,33 @@
+"""Mesh construction and candidate-batch sharding.
+
+The reference system's parallelism is volunteer data-parallelism over the
+candidate keyspace (SURVEY.md §2.10: independent clients, dictionary
+shards, coverage matrix).  On a TPU pod slice the same axis — candidates —
+is the natural shard dimension: PBKDF2 is embarrassingly parallel per
+candidate, so the hot loop needs *zero* cross-device traffic and only the
+tiny found-flags tensor is ever reduced over ICI (psum in parallel/step.py).
+
+One 1-D mesh axis ("dp") is therefore the whole story intra-pod; scaling
+further mirrors the reference's WAN layer (many independent clients each
+owning a pod slice), not a second mesh axis.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+
+
+def default_mesh(devices=None, n: int = None) -> Mesh:
+    """A 1-D data-parallel mesh over ``devices`` (default: all present)."""
+    if devices is None:
+        devices = jax.devices()
+    if n is not None:
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), (DP_AXIS,))
+
+
+def shard_candidates(mesh: Mesh, pw_words):
+    """Place a packed [B, 16] candidate batch with B split over the mesh."""
+    return jax.device_put(pw_words, NamedSharding(mesh, P(DP_AXIS, None)))

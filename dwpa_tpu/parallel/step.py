@@ -1,0 +1,62 @@
+"""The sharded full crack step: PBKDF2 -> verify, shard_map'd over the mesh.
+
+``build_crack_step`` closes over a prepped net list and returns one jitted
+function that runs the complete pipeline for a candidate batch:
+
+- the [B, 16] packed-password batch is split over the "dp" mesh axis;
+- each device runs PBKDF2(4096) + every net's MIC/PMKID check on its local
+  candidate shard — no communication at all in the hot loop;
+- the only collective is a ``psum`` of the scalar hit count over ICI, used
+  by the host as a cheap "anything found?" gate before it pulls the
+  (dp-sharded) per-net match matrix back for the rare positives.
+
+This is the TPU mapping of the reference's work distribution (volunteer
+data parallelism + ESSID-amortized PBKDF2, web/content/get_work.php:96-109)
+described in SURVEY.md §5.7.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import m22000 as m
+from .mesh import DP_AXIS
+
+
+def build_crack_step(mesh, nets, salt1, salt2):
+    """Jit the full crack step for one ESSID group over ``mesh``.
+
+    ``nets``: list of PreppedNet sharing one ESSID (constants are folded
+    into the trace).  Returns ``step(pw_words[B,16]) -> (hits[], found)``
+    where ``found`` is bool[N, V_max, B] (variant axes zero-padded so the
+    per-net matrices stack; B must be divisible by the mesh size).
+    """
+    s1 = jnp.asarray(salt1)
+    s2 = jnp.asarray(salt2)
+    v_max = max(1 if n.keyver == 100 else len(n.variants) for n in nets)
+
+    def local_step(pw_words):
+        pmk = m._pmk_impl(pw_words, s1, s2)
+        per_net = []
+        for net in nets:
+            mv = m.net_match(pmk, net)  # [V, b]
+            pad = v_max - mv.shape[0]
+            if pad:
+                mv = jnp.concatenate(
+                    [mv, jnp.zeros((pad,) + mv.shape[1:], dtype=mv.dtype)]
+                )
+            per_net.append(mv)
+        found = jnp.stack(per_net)  # [N, V_max, b]
+        hits = jax.lax.psum(jnp.sum(found, dtype=jnp.int32), DP_AXIS)
+        return hits, found
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(DP_AXIS, None),),
+        out_specs=(P(), P(None, None, DP_AXIS)),
+    )
+    return jax.jit(
+        sharded,
+        in_shardings=(NamedSharding(mesh, P(DP_AXIS, None)),),
+    )

@@ -1,0 +1,3 @@
+"""Hashcat-compatible rule engine (host-side candidate mangling)."""
+
+from .engine import Rule, RuleError, apply_rules, parse_rule, parse_rules  # noqa: F401

@@ -1,0 +1,161 @@
+"""HTTP front controller (WSGI) — the web/index.php + web/content/* layer.
+
+Routes by query-string key exactly like the reference front controller
+(web/index.php:146-163), with the four machine interfaces bypassing any
+HTML chrome:
+
+- ``?get_work=<ver>``  POST {"dictcount": N} -> work-unit JSON,
+  or sentinel bodies ``Version`` / ``No nets`` (get_work.php:25-27,77-81);
+- ``?put_work``        POST candidate JSON -> ``OK`` / ``Nope``;
+- ``?prdict=<hkey>``   gzip dynamic dictionary stream (prdict.php);
+- ``?api``             cookie-keyed potfile export (api.php);
+- ``?stats``           JSON stats (the machine-readable face of stats.php);
+- POST file upload     capture submission (index.php:4-11 besside path /
+  content/submit.php) — accepts m22000 text, gz, or pcap/pcapng captures;
+- ``dict/<name>``      static dictionary downloads.
+
+Serve with ``wsgiref.simple_server`` (tests, small sites) or any WSGI
+container.
+"""
+
+import json
+import gzip
+import os
+import re
+import urllib.parse
+
+from .core import ServerCore
+from .capture import extract_hashlines
+
+MIN_HC_VER = "2.1.1"  # oldest client protocol accepted (conf.php:29)
+
+
+def _version_ok(ver: str) -> bool:
+    def parts(v):
+        return [int(x) for x in re.findall(r"\d+", v)][:3]
+
+    try:
+        return parts(ver) >= parts(MIN_HC_VER)
+    except ValueError:
+        return False
+
+
+def make_wsgi_app(core: ServerCore):
+    def app(environ, start_response):
+        try:
+            status, ctype, body = _route(core, environ)
+        except ValueError as e:
+            status, ctype, body = "400 Bad Request", "text/plain", str(e).encode()
+        start_response(status, [("Content-Type", ctype),
+                                ("Content-Length", str(len(body)))])
+        return [body]
+
+    return app
+
+
+def _read_body(environ, cap=64 * 1024 * 1024) -> bytes:
+    try:
+        n = int(environ.get("CONTENT_LENGTH") or 0)
+    except ValueError:
+        n = 0
+    return environ["wsgi.input"].read(min(n, cap)) if n else b""
+
+
+def _route(core: ServerCore, environ):
+    qs = urllib.parse.parse_qs(environ.get("QUERY_STRING", ""), keep_blank_values=True)
+    path = environ.get("PATH_INFO", "/")
+
+    if path.startswith("/dict/") and core.dictdir:
+        name = os.path.basename(path)
+        full = os.path.join(core.dictdir, name)
+        if os.path.isfile(full):
+            with open(full, "rb") as f:
+                return "200 OK", "application/octet-stream", f.read()
+        return "404 Not Found", "text/plain", b"no such dict"
+
+    if "get_work" in qs:
+        ver = qs["get_work"][0]
+        if not _version_ok(ver):
+            return "200 OK", "text/plain", b"Version"
+        try:
+            req = json.loads(_read_body(environ) or b"{}")
+        except ValueError:
+            req = {}
+        work = core.get_work(int(req.get("dictcount", 1)))
+        if work is None:
+            return "200 OK", "text/plain", b"No nets"
+        return "200 OK", "application/json", json.dumps(work).encode()
+
+    if "put_work" in qs:
+        try:
+            data = json.loads(_read_body(environ) or b"{}")
+        except ValueError:
+            return "200 OK", "text/plain", b"Nope"
+        data.setdefault("ip", environ.get("REMOTE_ADDR", ""))
+        ok = core.put_work(data)
+        return "200 OK", "text/plain", b"OK" if ok else b"Nope"
+
+    if "prdict" in qs:
+        words = core.prdict_words(qs["prdict"][0])
+        blob = gzip.compress(b"\n".join(words) + b"\n")
+        return "200 OK", "application/octet-stream", blob
+
+    if "api" in qs:
+        key = qs.get("key", [""])[0] or _cookie_key(environ)
+        lines = core.user_potfile(key)
+        return "200 OK", "text/plain", ("\n".join(lines) + "\n").encode()
+
+    if "stats" in qs:
+        rows = core.db.q("SELECT name, value FROM stats")
+        return (
+            "200 OK", "application/json",
+            json.dumps({r["name"]: r["value"] for r in rows}).encode(),
+        )
+
+    if environ["REQUEST_METHOD"] == "POST":
+        # capture submission (multipart not required: raw body accepted,
+        # like the besside-ng direct upload path)
+        blob = _read_body(environ)
+        if not blob:
+            return "400 Bad Request", "text/plain", b"empty submission"
+        report = submit_capture(core, blob,
+                                ip=environ.get("REMOTE_ADDR", ""),
+                                userkey=qs.get("key", [None])[0])
+        return "200 OK", "application/json", json.dumps(report).encode()
+
+    return "200 OK", "text/plain", b"dwpa_tpu server"
+
+
+def _cookie_key(environ) -> str:
+    cookies = environ.get("HTTP_COOKIE", "")
+    for part in cookies.split(";"):
+        k, _, v = part.strip().partition("=")
+        if k == "key":
+            return v
+    return ""
+
+
+def submit_capture(core: ServerCore, blob: bytes, ip: str = "",
+                   userkey: str = None) -> dict:
+    """Ingest one uploaded capture (pcap/pcapng/gz or m22000 text).
+
+    The reference shells out to hcxpcapngtool here (common.php:481); we
+    parse captures natively (capture.py) and also accept pre-extracted
+    hashline text so converted archives ingest directly.
+    """
+    if blob[:2] == b"\x1f\x8b":
+        try:
+            blob = gzip.decompress(blob)
+        except OSError:
+            raise ValueError("bad gzip")
+    s_id = core.add_submission(blob, ip=ip)
+    if blob[:4].lstrip()[:3] == b"WPA":
+        lines = blob.decode("utf-8", "replace").splitlines()
+        probes = []
+    else:
+        lines, probes = extract_hashlines(blob)
+    report = core.add_hashlines(lines, s_id=s_id, ip=ip, userkey=userkey)
+    if probes:
+        core.add_probe_requests(probes, s_id)
+        report["probes"] = len(probes)
+    return report

@@ -1,0 +1,382 @@
+"""Server business logic: ingestion, scheduling, result acceptance.
+
+The functional equivalent of the reference's core library (web/common.php)
+and work API (web/content/get_work.php, put_work.php), re-homed on sqlite:
+
+- ``add_hashlines`` / ``submit_capture``: the ingestion pipeline
+  (submission(), common.php:470-718): dedup by net identity, zero-PMK
+  probe, cross-crack against already-cracked siblings, batch insert,
+  PROBEREQUEST bookkeeping, user association;
+- ``get_work``: the scheduler (get_work.php): pick the least-tried oldest
+  released net, its untried smallest dicts, group every uncracked same-SSID
+  net into the unit, lease coverage rows in n2d under a fresh hkey;
+- ``put_work``: result acceptance (common.php:849-959): every claimed PSK
+  is independently re-verified (oracle.check_key_m22000, full-width NC),
+  then the cracked PMK is replayed against siblings sharing ssid/bssid/
+  mac_sta without re-running PBKDF2; an ESSID-mismatched sibling (a
+  "broken essid" net that verifies with the wrong ESSID's PMK) is
+  cascade-deleted;
+- maintenance & keygen jobs live in jobs.py.
+
+Verification runs the pure-Python oracle per claim (claims are rare); bulk
+device verification belongs to the client side.
+"""
+
+import base64
+import hashlib
+import os
+import secrets
+
+from ..models import hashline as hl
+from ..oracle import m22000 as oracle
+from .db import Database, mac2long, now
+
+MAX_CANDS_PER_PUT = 200     # put_work cap (reference: common.php:937)
+MAX_DICTCOUNT = 15          # dictcount clamp (get_work.php:41-46)
+LEASE_REAP_S = 3 * 3600     # stale work-unit reclaim (maint.php:36)
+SERVER_NC = 128             # server-side NC search width (common.php:157)
+
+
+def gen_key() -> str:
+    """16 random bytes hex — hkey/userkey format (common.php:976-978)."""
+    return secrets.token_hex(16)
+
+
+class ServerCore:
+    def __init__(self, db: Database, dictdir: str = None, capdir: str = None):
+        self.db = db
+        self.dictdir = dictdir
+        self.capdir = capdir
+
+    # ------------------------------------------------------------------
+    # Ingestion
+    # ------------------------------------------------------------------
+
+    def add_submission(self, blob: bytes, ip: str = "") -> int:
+        """Record a capture file (md5-dedup); returns s_id."""
+        md5 = hashlib.md5(blob).digest()
+        row = self.db.q1("SELECT s_id FROM submissions WHERE hash = ?", (md5,))
+        if row:
+            return row["s_id"]
+        localfile = None
+        if self.capdir:
+            os.makedirs(self.capdir, exist_ok=True)
+            localfile = os.path.join(self.capdir, md5.hex())
+            with open(localfile, "wb") as f:
+                f.write(blob)
+        cur = self.db.x(
+            "INSERT INTO submissions(localfile, hash, ip) VALUES (?, ?, ?)",
+            (localfile, md5, ip),
+        )
+        return cur.lastrowid
+
+    def add_hashlines(self, lines, s_id: int = None, ip: str = "",
+                      userkey: str = None) -> dict:
+        """Ingest parsed/parsable m22000 lines; returns a report dict."""
+        report = {"new": 0, "dup": 0, "bad": 0, "precracked": 0}
+        new_ids = []
+        for line in lines:
+            try:
+                h = line if isinstance(line, hl.Hashline) else hl.parse(line)
+            except ValueError:
+                report["bad"] += 1
+                continue
+            if h.hash_type == hl.TYPE_EAPOL and h.keyver not in (1, 2, 3):
+                report["bad"] += 1
+                continue
+            key_id = h.key_id()
+            if self.db.q1("SELECT 1 FROM nets WHERE hash = ?", (key_id,)):
+                report["dup"] += 1
+                continue
+
+            n_state, passb, pmk, algo, nc, endian = 0, None, None, None, None, None
+            # zero-PMK probe: some broken APs derive everything from an
+            # all-zero PMK (ingest-time check, common.php:592-600)
+            z = oracle.check_key_m22000(h, [b""], pmk=b"\x00" * 32, nc=SERVER_NC)
+            if z:
+                n_state, passb, pmk, algo = 1, b"", z[3], "ZeroPMK"
+                nc, endian = z[1] or 0, z[2] or ""
+                report["precracked"] += 1
+            else:
+                # cross-crack: replay PMKs of cracked siblings (same ssid /
+                # bssid / mac_sta) before volunteers ever see this net
+                for sib in self._handshakes_like(h, n_state=1):
+                    if sib["pmk"] is None:
+                        continue
+                    r = oracle.check_key_m22000(h, [sib["pass"] or b""],
+                                                pmk=sib["pmk"], nc=SERVER_NC)
+                    if r:
+                        n_state = 1
+                        passb, nc, endian, pmk = sib["pass"], r[1] or 0, r[2] or "", r[3]
+                        report["precracked"] += 1
+                        break
+
+            cur = self.db.x(
+                """INSERT OR IGNORE INTO nets
+                   (s_id, bssid, mac_sta, ssid, pass, pmk, algo, hash, struct,
+                    message_pair, keyver, nc, endian, sip, n_state)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (s_id, mac2long(h.mac_ap), mac2long(h.mac_sta), h.essid,
+                 passb, pmk, algo, key_id, h.raw, h.message_pair, h.keyver,
+                 nc, endian, ip, n_state),
+            )
+            if cur.rowcount:
+                report["new"] += 1
+                new_ids.append(cur.lastrowid)
+        if userkey and new_ids:
+            self.associate_user(userkey, new_ids)
+        return report
+
+    def add_probe_requests(self, ssids, s_id: int):
+        """PROBEREQUEST ssids -> prs/p2s (source of the dynamic dict)."""
+        for ssid in ssids:
+            if not ssid or len(ssid) > 32:
+                continue
+            self.db.x("INSERT OR IGNORE INTO prs(ssid) VALUES (?)", (ssid,))
+            p = self.db.q1("SELECT p_id FROM prs WHERE ssid = ?", (ssid,))
+            self.db.x(
+                "INSERT OR IGNORE INTO p2s(p_id, s_id) VALUES (?, ?)",
+                (p["p_id"], s_id),
+            )
+
+    def associate_user(self, userkey: str, net_ids):
+        u = self.db.q1("SELECT u_id FROM users WHERE userkey = ?", (userkey,))
+        if not u:
+            return
+        for nid in net_ids:
+            self.db.x(
+                "INSERT OR IGNORE INTO n2u(net_id, u_id) VALUES (?, ?)",
+                (nid, u["u_id"]),
+            )
+
+    def _handshakes_like(self, h: hl.Hashline, n_state: int):
+        """Nets sharing ssid OR bssid OR mac_sta (PMK-reuse candidates,
+        common.php:335-351)."""
+        return self.db.q(
+            """SELECT * FROM nets
+               WHERE (ssid = ? OR bssid = ? OR mac_sta = ?) AND n_state = ?""",
+            (h.essid, mac2long(h.mac_ap), mac2long(h.mac_sta), n_state),
+        )
+
+    # ------------------------------------------------------------------
+    # Dictionaries
+    # ------------------------------------------------------------------
+
+    def add_dict(self, dpath: str, dname: str, dhash: str, wcount: int,
+                 rules: str = None) -> int:
+        cur = self.db.x(
+            "INSERT INTO dicts(dpath, dname, dhash, rules, wcount) VALUES (?,?,?,?,?)",
+            (dpath, dname, dhash, rules, wcount),
+        )
+        return cur.lastrowid
+
+    # ------------------------------------------------------------------
+    # The scheduler: get_work
+    # ------------------------------------------------------------------
+
+    def get_work(self, dictcount: int) -> dict:
+        """Build one work unit or return None ("No nets").
+
+        sqlite serializes writers, which stands in for the reference's
+        global SHM lock around this critical section (get_work.php:49).
+        """
+        dictcount = max(1, min(MAX_DICTCOUNT, int(dictcount)))
+        target = self.db.q1(
+            """SELECT net_id, ssid FROM nets
+               WHERE n_state = 0 AND algo = ''
+               ORDER BY hits, ts LIMIT 1"""
+        )
+        if not target:
+            return None
+        dicts = self.db.q(
+            """SELECT * FROM dicts WHERE d_id NOT IN
+                 (SELECT d_id FROM n2d WHERE net_id = ?)
+               ORDER BY wcount, dname LIMIT ?""",
+            (target["net_id"], dictcount),
+        )
+        if not dicts:
+            return None
+        d_ids = [d["d_id"] for d in dicts]
+        ph = ",".join("?" * len(d_ids))
+        # every uncracked net sharing the SSID, not yet covered by these dicts
+        nets = self.db.q(
+            f"""SELECT net_id, struct FROM nets
+                WHERE ssid = ? AND n_state = 0 AND algo = ''
+                  AND net_id NOT IN
+                    (SELECT net_id FROM n2d WHERE d_id IN ({ph}))""",
+            (target["ssid"], *d_ids),
+        )
+        if not nets:
+            return None
+        hkey = gen_key()
+        for n in nets:
+            for d in d_ids:
+                self.db.x(
+                    "INSERT OR IGNORE INTO n2d(net_id, d_id, hkey) VALUES (?,?,?)",
+                    (n["net_id"], d, hkey),
+                )
+        # merged, deduped per-dict rules (get_work.php:84-92)
+        seen, merged = set(), []
+        for d in dicts:
+            for ln in (d["rules"] or "").splitlines():
+                if ln and ln not in seen:
+                    seen.add(ln)
+                    merged.append(ln)
+        work = {
+            "hkey": hkey,
+            "dicts": [{"dhash": d["dhash"], "dpath": d["dpath"]} for d in dicts],
+            "hashes": [n["struct"] for n in nets],
+        }
+        if merged:
+            work["rules"] = base64.b64encode("\n".join(merged).encode()).decode()
+        if self._prdict_available(hkey):
+            work["prdict"] = True
+        return work
+
+    def _prdict_available(self, hkey: str) -> bool:
+        """PROBEREQUEST dict availability for a work unit: prs rows joined
+        through p2s -> submissions -> nets -> n2d.hkey (prdict.php:17-29)."""
+        row = self.db.q1(
+            """SELECT 1 FROM prs p
+               JOIN p2s ON p.p_id = p2s.p_id
+               JOIN nets n ON n.s_id = p2s.s_id
+               JOIN n2d ON n2d.net_id = n.net_id
+               WHERE n2d.hkey = ? LIMIT 1""",
+            (hkey,),
+        )
+        return row is not None
+
+    def prdict_words(self, hkey: str) -> list:
+        rows = self.db.q(
+            """SELECT DISTINCT p.ssid FROM prs p
+               JOIN p2s ON p.p_id = p2s.p_id
+               JOIN nets n ON n.s_id = p2s.s_id
+               JOIN n2d ON n2d.net_id = n.net_id
+               WHERE n2d.hkey = ?""",
+            (hkey,),
+        )
+        out = []
+        for r in rows:
+            ssid = r["ssid"]
+            try:
+                printable = ssid.decode("ascii").isprintable()
+            except UnicodeDecodeError:
+                printable = False
+            out.append(ssid if printable else b"$HEX[%s]" % ssid.hex().encode())
+        return out
+
+    # ------------------------------------------------------------------
+    # Result acceptance: put_work
+    # ------------------------------------------------------------------
+
+    def put_work(self, data: dict) -> bool:
+        cands = data.get("cand") or []
+        ctype = data.get("type", "bssid")
+        hkey = data.get("hkey")
+        if not isinstance(cands, list):
+            return False
+        for pair in cands[:MAX_CANDS_PER_PUT]:
+            k, v = pair.get("k"), pair.get("v")
+            if not isinstance(k, str) or not isinstance(v, str):
+                continue
+            try:
+                psk = bytes.fromhex(v)
+            except ValueError:
+                psk = oracle.hc_unhex(v)
+            for net in self._nets_for_claim(ctype, k):
+                self._try_accept(net, psk, submitter=data.get("ip", ""))
+        if hkey:
+            self.db.x("UPDATE n2d SET hkey = NULL WHERE hkey = ?", (hkey,))
+        return True
+
+    def _nets_for_claim(self, ctype: str, key: str):
+        if ctype == "bssid":
+            try:
+                b = int(key, 16)
+            except ValueError:
+                return []
+            return self.db.q(
+                "SELECT * FROM nets WHERE bssid = ? AND n_state = 0", (b,)
+            )
+        if ctype == "ssid":
+            return self.db.q(
+                "SELECT * FROM nets WHERE ssid = ? AND n_state = 0",
+                (key.encode("latin1", "ignore"),),
+            )
+        if ctype == "hash":
+            try:
+                hh = bytes.fromhex(key)
+            except ValueError:
+                return []
+            return self.db.q(
+                "SELECT * FROM nets WHERE hash = ? AND n_state = 0", (hh,)
+            )
+        return []
+
+    def _try_accept(self, net, psk: bytes, submitter: str = ""):
+        """Independent re-verification + PMK-reuse sweep."""
+        h = hl.parse(net["struct"])
+        r = oracle.check_key_m22000(h, [psk], nc=SERVER_NC)
+        if not r:
+            return False
+        psk_b, nc, endian, pmk = r
+        self._mark_cracked(net["net_id"], psk_b, pmk, nc or 0, endian or "")
+        # replay this PMK against uncracked siblings (common.php:916-932)
+        for sib in self._handshakes_like(h, n_state=0):
+            sh = hl.parse(sib["struct"])
+            rr = oracle.check_key_m22000(sh, [psk_b], pmk=pmk, nc=SERVER_NC)
+            if not rr:
+                continue
+            if sh.essid == h.essid:
+                self._mark_cracked(sib["net_id"], psk_b, pmk, rr[1] or 0, rr[2] or "")
+            else:
+                # MIC verifies with a PMK derived from a different ESSID:
+                # the stored ESSID is broken -> cascade delete
+                self._delete_net(sib["net_id"])
+        return True
+
+    def _mark_cracked(self, net_id: int, psk: bytes, pmk: bytes, nc: int, endian: str):
+        self.db.x(
+            """UPDATE nets SET pass = ?, pmk = ?, nc = ?, endian = ?,
+                              n_state = 1, ts = ? WHERE net_id = ?""",
+            (psk, pmk, nc, endian, now(), net_id),
+        )
+        self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
+
+    def _delete_net(self, net_id: int):
+        row = self.db.q1("SELECT bssid FROM nets WHERE net_id = ?", (net_id,))
+        self.db.x("DELETE FROM nets WHERE net_id = ?", (net_id,))
+        if row and not self.db.q1(
+            "SELECT 1 FROM nets WHERE bssid = ? LIMIT 1", (row["bssid"],)
+        ):
+            self.db.x("DELETE FROM bssids WHERE bssid = ?", (row["bssid"],))
+
+    # ------------------------------------------------------------------
+    # Users & potfile export
+    # ------------------------------------------------------------------
+
+    def create_user(self, mail: str) -> str:
+        key = gen_key()
+        self.db.x(
+            "INSERT INTO users(userkey, mail) VALUES (?, ?) "
+            "ON CONFLICT(mail) DO UPDATE SET userkey = excluded.userkey",
+            (key, mail),
+        )
+        return key
+
+    def user_potfile(self, userkey: str) -> list:
+        """All of a user's cracked nets as bssid:mac_sta:ssid:pass lines
+        (api.php:9-28)."""
+        rows = self.db.q(
+            """SELECT n.* FROM nets n JOIN n2u ON n.net_id = n2u.net_id
+               JOIN users u ON u.u_id = n2u.u_id
+               WHERE u.userkey = ? AND n.n_state = 1""",
+            (userkey,),
+        )
+        out = []
+        for r in rows:
+            mac_ap = f"{r['bssid']:012x}"
+            mac_sta = f"{r['mac_sta']:012x}"
+            ssid = r["ssid"].decode("latin1")
+            out.append(f"{mac_ap}:{mac_sta}:{ssid}:{(r['pass'] or b'').decode('latin1')}")
+        return out

@@ -1,0 +1,199 @@
+"""Data model on sqlite3.
+
+Mirrors the reference schema's entities and invariants (db/wpa.sql: nets,
+submissions, bssids, dicts, n2d, n2u, users, rkg, prs, p2s, ks, stats —
+see SURVEY.md §2.6) with idiomatic-sqlite choices instead of a literal DDL
+translation:
+
+- MACs stored as INTEGER (the reference packs them into BIGINT too);
+- counter maintenance (nets.hits / dicts.hits) done by triggers exactly as
+  the reference pushes it into the DB (wpa.sql:107-121), so concurrent
+  writers stay consistent;
+- the nets.hash / submissions.hash uniqueness + INSERT OR IGNORE give the
+  same idempotent-ingestion semantics;
+- WAL journal + a single write connection per process stand in for the
+  reference's SHM lockfile around the get_work critical section.
+"""
+
+import sqlite3
+import time
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS nets (
+    net_id   INTEGER PRIMARY KEY,
+    s_id     INTEGER REFERENCES submissions(s_id),
+    u_id     INTEGER,
+    bssid    INTEGER NOT NULL,
+    mac_sta  INTEGER NOT NULL,
+    ssid     BLOB NOT NULL,
+    pass     BLOB,
+    pmk      BLOB,
+    algo     TEXT,              -- NULL = keygen unprocessed, '' = released
+    hash     BLOB NOT NULL UNIQUE,  -- md5 net identity (hashline fields 1-7)
+    struct   TEXT NOT NULL,     -- the m22000 hashline
+    message_pair INTEGER,
+    keyver   INTEGER NOT NULL,  -- 1|2|3|100=PMKID
+    nc       INTEGER,
+    endian   TEXT,
+    sip      TEXT,
+    sts      REAL NOT NULL DEFAULT (strftime('%s','now')),
+    n_state  INTEGER NOT NULL DEFAULT 0,  -- 0 uncracked, 1 cracked, 2 uncrackable
+    hits     INTEGER NOT NULL DEFAULT 0,
+    ts       REAL NOT NULL DEFAULT (strftime('%s','now'))
+);
+CREATE INDEX IF NOT EXISTS idx_nets_sched ON nets(n_state, hits, ts, algo);
+CREATE INDEX IF NOT EXISTS idx_nets_bssid ON nets(bssid);
+CREATE INDEX IF NOT EXISTS idx_nets_ssid ON nets(ssid);
+CREATE INDEX IF NOT EXISTS idx_nets_mac_sta ON nets(mac_sta);
+
+CREATE TABLE IF NOT EXISTS submissions (
+    s_id      INTEGER PRIMARY KEY,
+    localfile TEXT,
+    hash      BLOB NOT NULL UNIQUE,   -- md5 of the capture file
+    ip        TEXT,
+    ts        REAL NOT NULL DEFAULT (strftime('%s','now'))
+);
+
+CREATE TABLE IF NOT EXISTS bssids (
+    bssid   INTEGER PRIMARY KEY,
+    flags   INTEGER NOT NULL DEFAULT 0,   -- bit1 = 3wifi done, bit2 = wigle done
+    lat     REAL, lon REAL,
+    country TEXT, region TEXT, city TEXT,
+    ts      REAL NOT NULL DEFAULT (strftime('%s','now'))
+);
+
+CREATE TABLE IF NOT EXISTS dicts (
+    d_id   INTEGER PRIMARY KEY,
+    dpath  TEXT NOT NULL,
+    dname  TEXT NOT NULL,
+    dhash  TEXT NOT NULL,
+    rules  TEXT,
+    wcount INTEGER NOT NULL DEFAULT 0,
+    hits   INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE IF NOT EXISTS n2d (
+    net_id INTEGER NOT NULL REFERENCES nets(net_id) ON DELETE CASCADE,
+    d_id   INTEGER NOT NULL REFERENCES dicts(d_id),
+    hkey   TEXT,                -- non-NULL = in-flight work unit lease
+    ts     REAL NOT NULL DEFAULT (strftime('%s','now')),
+    PRIMARY KEY (net_id, d_id)
+);
+CREATE INDEX IF NOT EXISTS idx_n2d_hkey ON n2d(hkey);
+
+CREATE TRIGGER IF NOT EXISTS trg_n2d_ins AFTER INSERT ON n2d BEGIN
+    UPDATE nets  SET hits = hits + 1 WHERE net_id = NEW.net_id;
+    UPDATE dicts SET hits = hits + 1 WHERE d_id  = NEW.d_id;
+END;
+CREATE TRIGGER IF NOT EXISTS trg_n2d_del AFTER DELETE ON n2d
+WHEN (SELECT n_state FROM nets WHERE net_id = OLD.net_id) = 0 BEGIN
+    UPDATE nets  SET hits = MAX(hits - 1, 0) WHERE net_id = OLD.net_id;
+    UPDATE dicts SET hits = MAX(hits - 1, 0) WHERE d_id  = OLD.d_id;
+END;
+
+CREATE TRIGGER IF NOT EXISTS trg_nets_bssids AFTER INSERT ON nets BEGIN
+    INSERT OR IGNORE INTO bssids(bssid) VALUES (NEW.bssid);
+END;
+
+CREATE TABLE IF NOT EXISTS n2u (
+    net_id INTEGER NOT NULL REFERENCES nets(net_id) ON DELETE CASCADE,
+    u_id   INTEGER NOT NULL REFERENCES users(u_id),
+    PRIMARY KEY (net_id, u_id)
+);
+
+CREATE TABLE IF NOT EXISTS users (
+    u_id      INTEGER PRIMARY KEY,
+    userkey   TEXT UNIQUE,
+    linkkey   TEXT,
+    linkkeyts REAL,
+    mail      TEXT UNIQUE,
+    ts        REAL NOT NULL DEFAULT (strftime('%s','now'))
+);
+
+CREATE TABLE IF NOT EXISTS rkg (
+    net_id  INTEGER NOT NULL REFERENCES nets(net_id) ON DELETE CASCADE,
+    algo    TEXT NOT NULL,
+    pass    BLOB NOT NULL,
+    n_state INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE IF NOT EXISTS prs (
+    p_id         INTEGER PRIMARY KEY,
+    ssid         BLOB NOT NULL UNIQUE,
+    default_ssid INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS p2s (
+    p_id INTEGER NOT NULL REFERENCES prs(p_id),
+    s_id INTEGER NOT NULL REFERENCES submissions(s_id),
+    PRIMARY KEY (p_id, s_id)
+);
+
+CREATE TABLE IF NOT EXISTS ks (
+    ssid_regex TEXT NOT NULL,
+    pass_regex TEXT NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS stats (
+    name  TEXT PRIMARY KEY,
+    value INTEGER NOT NULL DEFAULT 0
+);
+"""
+
+STAT_NAMES = [
+    "nets", "cracked", "uncracked", "pmkid", "pmkid_cracked", "rkg", "rkg_cracked",
+    "geo", "submissions", "users", "words", "triedwords", "24getwork", "24psk",
+    "24sub", "24founds", "contributors",
+]
+
+
+class Database:
+    """One sqlite connection with the dwpa schema applied."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.row_factory = sqlite3.Row
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA foreign_keys=ON")
+        self.conn.executescript(SCHEMA)
+        self.conn.executemany(
+            "INSERT OR IGNORE INTO stats(name, value) VALUES (?, 0)",
+            [(n,) for n in STAT_NAMES],
+        )
+        self.conn.commit()
+
+    def close(self):
+        self.conn.close()
+
+    # -- tiny helpers ------------------------------------------------------
+
+    def q(self, sql, params=()):
+        return self.conn.execute(sql, params).fetchall()
+
+    def q1(self, sql, params=()):
+        return self.conn.execute(sql, params).fetchone()
+
+    def x(self, sql, params=()):
+        cur = self.conn.execute(sql, params)
+        self.conn.commit()
+        return cur
+
+    def set_stat(self, name: str, value: int):
+        self.x("INSERT OR REPLACE INTO stats(name, value) VALUES (?, ?)", (name, value))
+
+    def get_stat(self, name: str) -> int:
+        row = self.q1("SELECT value FROM stats WHERE name = ?", (name,))
+        return row["value"] if row else 0
+
+
+def mac2long(mac: bytes) -> int:
+    """6-byte MAC -> int (MACs live as integers, like the reference's BIGINT)."""
+    return int.from_bytes(mac, "big")
+
+
+def long2mac(v: int) -> bytes:
+    return int(v).to_bytes(6, "big")
+
+
+def now() -> float:
+    return time.time()

@@ -1,0 +1,215 @@
+"""Async maintenance jobs (the reference's cron layer, SURVEY.md §2.7).
+
+- ``maintenance``: hourly stats recompute, stale work-unit lease reaping
+  (3 h, the elastic-recovery mechanism — maint.php:36), cracked-dictionary
+  regeneration ordered by password frequency (maint.php:41-77);
+- ``keygen_precompute``: the rkg.php equivalent — per unprocessed net, run
+  default-key generators + the "Single" bssid/ssid mutation generator,
+  verify each candidate with the oracle, and finally set ``algo`` (''
+  when nothing matched), which *releases* the net to the get_work
+  scheduler (get_work only serves algo='' nets, get_work.php:65,101);
+- ``geolocate``: wigle.php/3wifi.php equivalent, behind a pluggable
+  lookup function (this environment has zero egress; the reference calls
+  external HTTP APIs with throttles).
+
+Run them from a scheduler loop or one-shot (``python -m dwpa_tpu.server
+--jobs`` style); they are plain functions over the Database.
+"""
+
+import gzip
+import hashlib
+import os
+import time
+
+from ..gen.psktool import psk_candidates
+from ..models import hashline as hl
+from ..oracle import m22000 as oracle
+from .core import LEASE_REAP_S, SERVER_NC, ServerCore
+from .db import long2mac
+
+
+def maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
+    """Stats + lease reaping + cracked-dict regen; returns the stats."""
+    db = core.db
+    day_ago = time.time() - 86400
+
+    # reap stale in-flight leases (vanished volunteers cost one lease window)
+    db.x(
+        "UPDATE n2d SET hkey = NULL WHERE hkey IS NOT NULL AND ts < ?",
+        (time.time() - LEASE_REAP_S,),
+    )
+
+    s = {}
+    s["nets"] = db.q1("SELECT COUNT(*) c FROM nets")["c"]
+    s["cracked"] = db.q1("SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"]
+    s["uncracked"] = db.q1("SELECT COUNT(*) c FROM nets WHERE n_state = 0")["c"]
+    s["pmkid"] = db.q1("SELECT COUNT(*) c FROM nets WHERE keyver = 100")["c"]
+    s["pmkid_cracked"] = db.q1(
+        "SELECT COUNT(*) c FROM nets WHERE keyver = 100 AND n_state = 1"
+    )["c"]
+    s["rkg"] = db.q1("SELECT COUNT(DISTINCT net_id) c FROM rkg")["c"]
+    s["rkg_cracked"] = db.q1(
+        "SELECT COUNT(*) c FROM nets WHERE n_state = 1 AND algo != '' AND algo IS NOT NULL"
+    )["c"]
+    s["geo"] = db.q1("SELECT COUNT(*) c FROM bssids WHERE lat IS NOT NULL")["c"]
+    s["submissions"] = db.q1("SELECT COUNT(*) c FROM submissions")["c"]
+    s["users"] = db.q1("SELECT COUNT(*) c FROM users")["c"]
+    s["24sub"] = db.q1(
+        "SELECT COUNT(*) c FROM submissions WHERE ts > ?", (day_ago,)
+    )["c"]
+    s["24founds"] = db.q1(
+        "SELECT COUNT(*) c FROM nets WHERE n_state = 1 AND ts > ?", (day_ago,)
+    )["c"]
+    s["24getwork"] = db.q1(
+        "SELECT COUNT(DISTINCT hkey) c FROM n2d WHERE ts > ?", (day_ago,)
+    )["c"]
+    # 24 h keyspace throughput: sum of dict wordcounts over last-day leases
+    s["24psk"] = db.q1(
+        """SELECT COALESCE(SUM(d.wcount), 0) c FROM n2d
+           JOIN dicts d ON d.d_id = n2d.d_id WHERE n2d.ts > ?""",
+        (day_ago,),
+    )["c"]
+    total_words = db.q1("SELECT COALESCE(SUM(wcount), 0) c FROM dicts")["c"]
+    s["words"] = s["uncracked"] * total_words
+    s["triedwords"] = db.q1(
+        """SELECT COALESCE(SUM(d.wcount), 0) c FROM n2d
+           JOIN dicts d ON d.d_id = n2d.d_id
+           JOIN nets n ON n.net_id = n2d.net_id WHERE n.n_state = 0"""
+    )["c"]
+    s["contributors"] = db.q1(
+        "SELECT COUNT(DISTINCT hkey) c FROM n2d WHERE hkey IS NOT NULL"
+    )["c"]
+    for name, value in s.items():
+        db.set_stat(name, value)
+
+    if cracked_dict_path:
+        regen_cracked_dict(core, cracked_dict_path)
+    return s
+
+
+def regen_cracked_dict(core: ServerCore, path: str) -> int:
+    """cracked.txt.gz: distinct non-keygen passwords by frequency
+    (maint.php:41-64); non-printables emitted as $HEX[...]."""
+    rows = core.db.q(
+        """SELECT pass, COUNT(*) c FROM nets
+           WHERE n_state = 1 AND pass IS NOT NULL AND LENGTH(pass) >= 8
+             AND (algo = '' OR algo IS NULL)
+           GROUP BY pass ORDER BY c DESC"""
+    )
+    words = []
+    for r in rows:
+        p = r["pass"]
+        try:
+            printable = p.decode("ascii").isprintable()
+        except UnicodeDecodeError:
+            printable = False
+        words.append(p if printable else b"$HEX[%s]" % p.hex().encode())
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    data = b"\n".join(words) + (b"\n" if words else b"")
+    with open(path, "wb") as f:
+        f.write(gzip.compress(data, 9))
+    # update/insert the dict row so the scheduler hands it out
+    dhash = hashlib.md5(open(path, "rb").read()).hexdigest()
+    dname = os.path.basename(path)
+    row = core.db.q1("SELECT d_id FROM dicts WHERE dname = ?", (dname,))
+    if row:
+        core.db.x(
+            "UPDATE dicts SET dhash = ?, wcount = ? WHERE d_id = ?",
+            (dhash, len(words), row["d_id"]),
+        )
+    else:
+        core.add_dict("dict/" + dname, dname, dhash, len(words))
+    return len(words)
+
+
+def single_mode_candidates(bssid: bytes, ssid: bytes):
+    """The "Single" generator: bssid +/-1 in 12/10/8-hex widths and ssid
+    case/suffix mutations (rkg.php single_mode_generator, :48-77)."""
+    b = int.from_bytes(bssid, "big")
+    for delta in (0, 1, -1):
+        h = f"{(b + delta) & 0xFFFFFFFFFFFF:012x}"
+        for width in (12, 10, 8):
+            tail = h[12 - width:]
+            yield tail.encode()
+            yield tail.upper().encode()
+    text = ssid.decode("latin1")
+    for base in (text, text.lower(), text.upper()):
+        for suffix in ("", "1", "123", "!"):
+            cand = (base + suffix).encode("latin1")
+            if len(cand) >= 8:
+                yield cand
+
+
+def keygen_precompute(core: ServerCore, limit: int = 100,
+                      extra_generators=None) -> dict:
+    """Process up to ``limit`` nets with algo IS NULL; returns counts.
+
+    ``extra_generators``: optional iterable of callables
+    ``(bssid: bytes, ssid: bytes) -> iterable[tuple[str, bytes]]`` yielding
+    (algo_name, candidate) pairs — the seam where routerkeygen-style
+    vendor algorithms plug in.
+    """
+    db = core.db
+    nets = db.q(
+        "SELECT * FROM nets WHERE algo IS NULL AND n_state = 0 "
+        "ORDER BY net_id LIMIT ?", (limit,)
+    )
+    found = 0
+    for net in nets:
+        h = hl.parse(net["struct"])
+        bssid = long2mac(net["bssid"])
+        cands = [("Single", c) for c in single_mode_candidates(bssid, h.essid)]
+        cands += [("Pattern", c) for c in psk_candidates(h.essid, bssid)]
+        for gen in extra_generators or []:
+            cands += list(gen(bssid, h.essid))
+        hit_algo = ""
+        for algo, cand in cands:
+            db.x(
+                "INSERT INTO rkg(net_id, algo, pass) VALUES (?, ?, ?)",
+                (net["net_id"], algo, cand),
+            )
+            r = oracle.check_key_m22000(h, [cand], nc=SERVER_NC)
+            if r:
+                core._mark_cracked(
+                    net["net_id"], r[0], r[3], r[1] or 0, r[2] or ""
+                )
+                db.x(
+                    "UPDATE rkg SET n_state = 1 WHERE net_id = ? AND pass = ?",
+                    (net["net_id"], cand),
+                )
+                hit_algo = algo
+                found += 1
+                break
+        # setting algo (even '') releases the net to the volunteers
+        db.x(
+            "UPDATE nets SET algo = ? WHERE net_id = ?",
+            (hit_algo, net["net_id"]),
+        )
+    return {"processed": len(nets), "cracked": found}
+
+
+def geolocate(core: ServerCore, lookup, batch: int = 5) -> int:
+    """Enrich bssids rows via ``lookup(mac: bytes) -> dict|None`` with keys
+    lat/lon/country/region/city (wigle.php equivalent; the reference
+    throttles to 5 BSSIDs per run at 1 rps, wigle.php:37-53)."""
+    rows = core.db.q(
+        "SELECT bssid FROM bssids WHERE flags & 2 = 0 LIMIT ?", (batch,)
+    )
+    done = 0
+    for r in rows:
+        info = lookup(long2mac(r["bssid"]))
+        if info:
+            core.db.x(
+                """UPDATE bssids SET lat = ?, lon = ?, country = ?,
+                        region = ?, city = ?, flags = flags | 2
+                   WHERE bssid = ?""",
+                (info.get("lat"), info.get("lon"), info.get("country"),
+                 info.get("region"), info.get("city"), r["bssid"]),
+            )
+        else:
+            core.db.x(
+                "UPDATE bssids SET flags = flags | 2 WHERE bssid = ?",
+                (r["bssid"],),
+            )
+        done += 1
+    return done

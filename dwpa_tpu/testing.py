@@ -110,3 +110,92 @@ def make_eapol_line(
     return hl.serialize(
         hl.TYPE_EAPOL, mic, mac_ap, mac_sta, essid, anonce_rec, eapol, message_pair
     )
+
+
+# ---------------------------------------------------------------------------
+# Synthetic captures (for testing the hcxpcapngtool-equivalent parser)
+# ---------------------------------------------------------------------------
+
+
+def _dot11_mgmt(subtype: int, dst: bytes, src: bytes, bssid: bytes, body: bytes):
+    fc = (subtype << 4) | 0x00
+    return struct.pack("<HH", fc, 0) + dst + src + bssid + struct.pack("<H", 0) + body
+
+
+def _dot11_data_eapol(src: bytes, dst: bytes, bssid: bytes, eapol: bytes,
+                      from_ds: bool):
+    fc = 0x0008 | (0x0200 if from_ds else 0x0100)  # data frame, FromDS/ToDS
+    if from_ds:
+        a1, a2, a3 = dst, bssid, src
+    else:
+        a1, a2, a3 = bssid, src, dst
+    hdr = struct.pack("<HH", fc, 0) + a1 + a2 + a3 + struct.pack("<H", 0)
+    llc = b"\xaa\xaa\x03\x00\x00\x00\x88\x8e"
+    return hdr + llc + eapol
+
+
+def build_eapol_key_frame(key_information: int, replay: int, nonce: bytes,
+                          mic: bytes = b"\x00" * 16, key_data: bytes = b"") -> bytes:
+    """A full EAPOL-Key frame (802.1X header + key descriptor)."""
+    body = struct.pack(
+        ">BHH8s32s16s8s8s16sH",
+        2, key_information, 0,
+        replay.to_bytes(8, "big"), nonce,
+        b"\x00" * 16, b"\x00" * 8, b"\x00" * 8, mic, len(key_data),
+    ) + key_data
+    return struct.pack(">BBH", 2, 3, len(body)) + body
+
+
+def beacon_frame(bssid: bytes, essid: bytes) -> bytes:
+    body = b"\x00" * 12 + bytes([0, len(essid)]) + essid
+    return _dot11_mgmt(8, b"\xff" * 6, bssid, bssid, body)
+
+
+def probe_request_frame(sta: bytes, essid: bytes) -> bytes:
+    body = bytes([0, len(essid)]) + essid
+    return _dot11_mgmt(4, b"\xff" * 6, sta, b"\xff" * 6, body)
+
+
+def pcap_bytes(frames, linktype: int = 105) -> bytes:
+    """Wrap raw 802.11 frames in a classic little-endian pcap container."""
+    out = struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, linktype)
+    for i, fr in enumerate(frames):
+        out += struct.pack("<IIII", 1700000000 + i, 0, len(fr), len(fr)) + fr
+    return out
+
+
+def make_handshake_capture(psk: bytes, essid: bytes, seed: str = "cap",
+                           with_pmkid: bool = True, probes=()) -> tuple:
+    """A synthetic pcap holding beacon + M1 + M2 for a known PSK.
+
+    Returns (pcap_blob, expected_hashline_count).  The M2 MIC is real
+    (derived from the PSK via the oracle) so end-to-end ingest->crack
+    tests can recover ``psk``.
+    """
+    mac_ap = _rand(seed + "ap", 6)
+    mac_sta = _rand(seed + "sta", 6)
+    anonce = _rand(seed + "anonce", 32)
+    snonce = _rand(seed + "snonce", 32)
+    pmk = oracle.pmk_from_psk(psk, essid)
+
+    key_data_m1 = b""
+    expected = 1
+    if with_pmkid:
+        pmkid = oracle.compute_pmkid(pmk, mac_ap, mac_sta)
+        key_data_m1 = b"\xdd\x14\x00\x0f\xac\x04" + pmkid
+        expected = 2
+
+    m1 = build_eapol_key_frame(0x008A, 1, anonce, key_data=key_data_m1)
+    m2_zero = build_eapol_key_frame(0x010A, 1, snonce, key_data=_rand(seed + "rsn", 22))
+    m = min(mac_ap, mac_sta) + max(mac_ap, mac_sta)
+    n = snonce + anonce if snonce[:6] < anonce[:6] else anonce + snonce
+    mic = oracle.compute_mic(pmk, 2, m, n, m2_zero)
+    m2 = m2_zero[:81] + mic + m2_zero[97:]
+
+    frames = [beacon_frame(mac_ap, essid)]
+    frames += [probe_request_frame(_rand(seed + "p", 6), p) for p in probes]
+    frames += [
+        _dot11_data_eapol(mac_ap, mac_sta, mac_ap, m1, from_ds=True),
+        _dot11_data_eapol(mac_sta, mac_ap, mac_ap, m2, from_ds=False),
+    ]
+    return pcap_bytes(frames), expected

@@ -1,15 +1,23 @@
-"""Test env: force an 8-device virtual CPU platform before jax imports.
+"""Test env: force an 8-device virtual CPU platform.
 
 Mirrors how the driver validates multi-chip sharding: a
 ``jax.sharding.Mesh`` over 8 virtual CPU devices stands in for a TPU pod
-slice.  Must run before any test module imports jax.
+slice.  The container's sitecustomize registers the axon TPU plugin and
+overrides ``jax_platforms`` in every interpreter (jax is already imported
+before pytest starts), so setting env vars is not enough — the config must
+be updated after import, before any backend is initialized.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()

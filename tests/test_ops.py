@@ -40,6 +40,39 @@ def test_sha256_kats():
         assert got == hashlib.sha256(msg).digest(), msg
 
 
+def test_rolled_compress_variants():
+    """The rolled (fori_loop) compressions must match the unrolled forms."""
+    for msg in [b"abc", b"d" * 150]:
+        blocks = bo.message_blocks(msg)
+        st = sha1.sha1_init()
+        for blk in blocks:
+            st = sha1.sha1_compress_rolled(st, blk)
+        assert _digest(st) == hashlib.sha1(msg).digest(), msg
+
+        st = sha256.sha256_init()
+        for blk in blocks:
+            st = sha256.sha256_compress_rolled(st, blk)
+        assert _digest(st) == hashlib.sha256(msg).digest(), msg
+
+        st = md5.md5_init()
+        for blk in bo.message_blocks(msg, little_endian=True):
+            st = md5.md5_compress_rolled(st, blk)
+        assert _digest(st, le=True) == hashlib.md5(msg).digest(), msg
+
+
+def test_rolled_compress_batched():
+    msgs = [b"alpha-block-one!", b"beta-block-two!!", b"gamma-block-3!!!"]
+    blk = np.stack(
+        [np.array(bo.message_blocks(m)[0], np.uint32) for m in msgs]
+    )  # [3, 16]
+    st = sha1.sha1_compress_rolled(
+        sha1.sha1_init((3,)), [blk[:, w] for w in range(16)]
+    )
+    for i, msg in enumerate(msgs):
+        got = bo.words_to_bytes_be([np.asarray(w)[i] for w in st])
+        assert got == hashlib.sha1(msg).digest(), msg
+
+
 def _key_block(key: bytes):
     return bo.be_words(key + b"\x00" * (64 - len(key)))
 

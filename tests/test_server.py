@@ -1,0 +1,308 @@
+"""Server stack tests: capture parsing, ingestion, scheduler, acceptance,
+jobs — mirroring the reference's runtime guarantees (SURVEY.md §4): the
+server never trusts client output (independent re-verification), leases
+are reaped, coverage is never double-issued.
+"""
+
+import gzip
+import hashlib
+import json
+import io
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.oracle import m22000 as oracle
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+from dwpa_tpu.server.api import submit_capture
+from dwpa_tpu.server.capture import extract_hashlines
+from dwpa_tpu.server.jobs import (
+    keygen_precompute,
+    maintenance,
+    single_mode_candidates,
+)
+
+PSK = b"correct-battery"
+ESSID = b"TestLanParty"
+
+
+@pytest.fixture
+def core(tmp_path):
+    db = Database(":memory:")
+    return ServerCore(db, dictdir=str(tmp_path / "dicts"), capdir=str(tmp_path / "caps"))
+
+
+def _add_dict(core, words, name="small.txt.gz", rules=None):
+    import os
+    os.makedirs(core.dictdir, exist_ok=True)
+    blob = gzip.compress(b"\n".join(words) + b"\n")
+    path = f"{core.dictdir}/{name}"
+    with open(path, "wb") as f:
+        f.write(blob)
+    dhash = hashlib.md5(blob).hexdigest()
+    core.add_dict(f"dict/{name}", name, dhash, len(words), rules=rules)
+    return dhash
+
+
+# -- capture parsing -------------------------------------------------------
+
+
+def test_extract_hashlines_from_pcap():
+    blob, expected = tfx.make_handshake_capture(
+        PSK, ESSID, probes=[b"CoffeeShop", b"Airport-Free"]
+    )
+    lines, probes = extract_hashlines(blob)
+    assert len(lines) == expected == 2
+    assert probes == [b"CoffeeShop", b"Airport-Free"]
+    kinds = sorted(hl.parse(l).hash_type for l in lines)
+    assert kinds == [hl.TYPE_PMKID, hl.TYPE_EAPOL]
+    # the extracted lines must verify against the real PSK (oracle = spec)
+    for line in lines:
+        assert oracle.check_key_m22000(line, [PSK]) is not None, line
+
+
+def test_extracted_eapol_is_m1m2_pair():
+    blob, _ = tfx.make_handshake_capture(PSK, ESSID, with_pmkid=False)
+    lines, _ = extract_hashlines(blob)
+    assert len(lines) == 1
+    h = hl.parse(lines[0])
+    assert h.message_pair & 0x07 == 0  # M1+M2 encoding
+    assert h.keyver == 2
+
+
+# -- ingestion -------------------------------------------------------------
+
+
+def test_submission_pipeline(core):
+    blob, expected = tfx.make_handshake_capture(PSK, ESSID, probes=[b"HomeBox"])
+    report = submit_capture(core, blob, ip="1.2.3.4")
+    assert report["new"] == expected
+    assert report["probes"] == 1
+    # duplicate upload: same capture md5 -> same submission, nets deduped
+    report2 = submit_capture(core, blob)
+    assert report2["new"] == 0 and report2["dup"] == expected
+    assert core.db.q1("SELECT COUNT(*) c FROM submissions")["c"] == 1
+    # bssids auto-populated by trigger
+    assert core.db.q1("SELECT COUNT(*) c FROM bssids")["c"] == 1
+
+
+def test_ingest_cross_crack(core):
+    """A new net whose sibling (same SSID) is already cracked gets the PMK
+    replayed at ingest time and arrives pre-cracked."""
+    l1 = tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="cc1")
+    core.add_hashlines([l1])
+    net = core.db.q1("SELECT * FROM nets")
+    core._try_accept(net, PSK)
+    assert core.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+    l2 = tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="cc2")
+    report = core.add_hashlines([l2])
+    assert report["precracked"] == 1
+    states = [r["n_state"] for r in core.db.q("SELECT n_state FROM nets")]
+    assert states == [1, 1]
+
+
+def test_ingest_rejects_malformed(core):
+    report = core.add_hashlines(["not-a-hashline", "WPA*09*zz*x*y*z*a*b*c"])
+    assert report["bad"] == 2 and report["new"] == 0
+
+
+# -- scheduler -------------------------------------------------------------
+
+
+def _released(core):
+    core.db.x("UPDATE nets SET algo = '' WHERE algo IS NULL")
+
+
+def test_get_work_lifecycle(core):
+    lines = [
+        tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="w1"),
+        tfx.make_eapol_line(b"other-pass-9", ESSID, keyver=2, seed="w2"),
+        tfx.make_eapol_line(b"third-pass-3", b"OtherNet", keyver=2, seed="w3"),
+    ]
+    core.add_hashlines(lines)
+    assert core.get_work(1) is None  # nets not yet released (algo IS NULL)
+    _released(core)
+    assert core.get_work(1) is None  # no dicts yet
+    _add_dict(core, [b"foo-password", PSK], rules=":\n$1")
+    _add_dict(core, [b"a" * 9] * 3, name="bigger.txt.gz")
+
+    work = core.get_work(1)
+    assert work is not None
+    # same-SSID grouping: both TestLanParty nets ship in one unit
+    essids = {hl.parse(s).essid for s in work["hashes"]}
+    assert essids == {ESSID}
+    assert len(work["hashes"]) == 2
+    assert len(work["dicts"]) == 1  # dictcount honored
+    import base64
+    assert base64.b64decode(work["rules"]).decode().splitlines() == [":", "$1"]
+
+    # coverage leased under the hkey; second unit goes to the other ssid
+    leased = core.db.q1("SELECT COUNT(*) c FROM n2d WHERE hkey = ?", (work["hkey"],))["c"]
+    assert leased == 2
+    work2 = core.get_work(5)
+    assert {hl.parse(s).essid for s in work2["hashes"]} == {b"OtherNet"}
+    assert len(work2["dicts"]) == 2  # both dicts still untried for this net
+
+    # keyspace exhausted: nothing left to hand out
+    work3 = core.get_work(15)
+    assert work3 is not None  # TestLanParty x bigger dict remains
+    assert core.get_work(15) is None
+
+
+def test_put_work_verifies_and_reuses_pmk(core):
+    l1 = tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="pw1")
+    l2 = tfx.make_pmkid_line(PSK, ESSID, seed="pw2")  # sibling, same ssid
+    core.add_hashlines([l1, l2])
+    _released(core)
+    _add_dict(core, [PSK])
+    work = core.get_work(1)
+    bssid = hl.parse(l1).mac_ap.hex()
+
+    # bogus claim: rejected by independent re-verification
+    core.put_work({"hkey": work["hkey"], "type": "bssid",
+                   "cand": [{"k": bssid, "v": b"wrongpass1".hex()}]})
+    assert core.db.q1("SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"] == 0
+
+    # valid claim: accepted, and the PMK sweeps the same-ssid sibling
+    core.put_work({"hkey": work["hkey"], "type": "bssid",
+                   "cand": [{"k": bssid, "v": PSK.hex()}]})
+    rows = core.db.q("SELECT n_state, pass, pmk FROM nets")
+    assert all(r["n_state"] == 1 and r["pass"] == PSK for r in rows)
+    assert all(r["pmk"] == oracle.pmk_from_psk(PSK, ESSID) for r in rows)
+    # work unit closed: lease cleared
+    assert core.db.q1("SELECT COUNT(*) c FROM n2d WHERE hkey IS NOT NULL")["c"] == 0
+
+
+def test_put_work_broken_essid_cascade(core):
+    """A sibling whose MIC verifies under the wrong-ESSID PMK is bogus
+    (broken essid) and must be cascade-deleted."""
+    l1 = tfx.make_pmkid_line(PSK, ESSID, seed="be1")
+    core.add_hashlines([l1])
+    h1 = hl.parse(l1)
+    # forge a sibling: same bssid, different stored essid, but MIC computed
+    # from the ESSID-derived PMK (so it "verifies" with that PMK)
+    pmk = oracle.pmk_from_psk(PSK, ESSID)
+    mac_sta2 = bytes.fromhex("02aabbccddef")
+    pmkid2 = oracle.compute_pmkid(pmk, h1.mac_ap, mac_sta2)
+    forged = hl.serialize(hl.TYPE_PMKID, pmkid2, h1.mac_ap, mac_sta2,
+                          b"WrongSSID", message_pair=1)
+    core.add_hashlines([forged])
+    assert core.db.q1("SELECT COUNT(*) c FROM nets")["c"] == 2
+
+    net = core.db.q1("SELECT * FROM nets WHERE ssid = ?", (ESSID,))
+    core._try_accept(net, PSK)
+    rows = core.db.q("SELECT ssid, n_state FROM nets")
+    assert len(rows) == 1 and rows[0]["ssid"] == ESSID and rows[0]["n_state"] == 1
+
+
+# -- jobs ------------------------------------------------------------------
+
+
+def test_single_mode_candidates():
+    cands = list(single_mode_candidates(bytes.fromhex("a0b1c2d3e4f5"), b"HomeNet"))
+    assert b"a0b1c2d3e4f5" in cands
+    assert b"a0b1c2d3e4f6" in cands  # bssid + 1
+    assert b"HomeNet1" in cands and b"HomeNet123" in cands
+
+
+def test_keygen_precompute_release_and_crack(core):
+    # net crackable by the Single generator: psk = ssid + "123"
+    line = tfx.make_eapol_line(b"HomeNet123", b"HomeNet", keyver=2, seed="kg")
+    core.add_hashlines([line])
+    stats = keygen_precompute(core)
+    assert stats == {"processed": 1, "cracked": 1}
+    row = core.db.q1("SELECT * FROM nets")
+    assert row["n_state"] == 1 and row["pass"] == b"HomeNet123"
+    assert row["algo"] == "Single"
+    assert core.db.q1("SELECT COUNT(*) c FROM rkg WHERE n_state = 1")["c"] == 1
+
+    # uncrackable net just gets released (algo = '')
+    line2 = tfx.make_eapol_line(b"u$@-random-9911x", b"ZNet", keyver=2, seed="kg2")
+    core.add_hashlines([line2])
+    keygen_precompute(core)
+    row2 = core.db.q1("SELECT algo FROM nets WHERE ssid = ?", (b"ZNet",))
+    assert row2["algo"] == ""
+
+
+def test_maintenance_stats_and_lease_reap(core):
+    core.add_hashlines([tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="m1")])
+    _released(core)
+    _add_dict(core, [PSK])
+    work = core.get_work(1)
+    # age the lease beyond the reap window
+    core.db.x("UPDATE n2d SET ts = ts - 4 * 3600 WHERE hkey = ?", (work["hkey"],))
+    stats = maintenance(core)
+    assert stats["nets"] == 1 and stats["uncracked"] == 1
+    assert core.db.q1("SELECT COUNT(*) c FROM n2d WHERE hkey IS NOT NULL")["c"] == 0
+    # coverage row STAYS (dict counted as tried) — reference semantics
+    assert core.db.q1("SELECT COUNT(*) c FROM n2d")["c"] == 1
+
+
+# -- WSGI API --------------------------------------------------------------
+
+
+def _call(app, method="GET", path="/", qs="", body=b""):
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = status
+
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": qs,
+        "CONTENT_LENGTH": str(len(body)),
+        "wsgi.input": io.BytesIO(body),
+        "REMOTE_ADDR": "9.9.9.9",
+    }
+    chunks = app(environ, start_response)
+    return out["status"], b"".join(chunks)
+
+
+def test_wsgi_endpoints(core):
+    app = make_wsgi_app(core)
+
+    # old client version gated
+    status, body = _call(app, "POST", qs="get_work=2.0.0")
+    assert body == b"Version"
+    # no nets yet
+    status, body = _call(app, "POST", qs="get_work=2.2.0",
+                         body=json.dumps({"dictcount": 1}).encode())
+    assert body == b"No nets"
+
+    # submit a capture over HTTP
+    blob, expected = tfx.make_handshake_capture(PSK, ESSID, probes=[b"PrSsid"])
+    status, body = _call(app, "POST", body=blob)
+    assert json.loads(body)["new"] == expected
+    _released(core)
+    dhash = _add_dict(core, [b"xxxxxxxxx", PSK])
+
+    status, body = _call(app, "POST", qs="get_work=2.2.0",
+                         body=json.dumps({"dictcount": 1}).encode())
+    work = json.loads(body)
+    assert work["dicts"][0]["dhash"] == dhash
+    assert work.get("prdict") is True
+
+    # dict download + md5
+    status, body = _call(app, path="/" + work["dicts"][0]["dpath"])
+    assert hashlib.md5(body).hexdigest() == dhash
+
+    # prdict stream
+    status, body = _call(app, qs="prdict=" + work["hkey"])
+    assert b"PrSsid" in gzip.decompress(body)
+
+    # put_work round trip
+    bssid = hl.parse(work["hashes"][0]).mac_ap.hex()
+    status, body = _call(app, "POST", qs="put_work", body=json.dumps({
+        "hkey": work["hkey"], "type": "bssid",
+        "cand": [{"k": bssid, "v": PSK.hex()}],
+    }).encode())
+    assert body == b"OK"
+    assert core.db.q1("SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"] >= 1
+
+    # stats endpoint
+    maintenance(core)
+    status, body = _call(app, qs="stats")
+    assert json.loads(body)["cracked"] >= 1
