@@ -1,0 +1,126 @@
+"""Pallas TPU kernel for the PBKDF2-HMAC-SHA1 hot loop.
+
+Reference semantics: ``PMK = PBKDF2-HMAC-SHA1(psk, essid, 4096, 32)``
+(web/common.php:179).  The pure-XLA formulation (ops/pbkdf2.py) expresses
+the 4096-iteration loop as a ``lax.fori_loop`` whose carry is ten [2, B]
+uint32 arrays; measured on a v5e chip that plateaus near ~48k PMK/s
+because the carry round-trips through memory every iteration.  This
+kernel instead runs the *entire* loop inside one Pallas program per batch
+tile, so the SHA-1 state lives in vector registers for all 4096
+iterations and the only HBM traffic is the initial states in and the
+final accumulators out.
+
+Layout: the two PBKDF2 output blocks T1/T2 (a 32-byte PMK needs both)
+are folded into extra batch *lanes* rather than a leading axis — lane i
+computes T1 for candidate i, lane B+i computes T2.  Each Pallas program
+owns a (TILE, 128) lane tile; per 32-bit word that is TILE/8 vector
+registers, giving the VPU independent work to hide ALU latency across
+the serial SHA-1 round dependency chain.
+
+The kernel reuses the generic unrolled ``sha1_compress`` /
+``hmac_sha1_20`` ops — inside Pallas they trace to the same straight-line
+uint32 arithmetic, just on register-resident (TILE, 128) tiles.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .hmac import hmac_sha1_20, hmac_sha1_blocks, hmac_sha1_precompute
+
+# Lane-tile sublane count per Pallas program.  (TILE, 128) uint32 words;
+# TILE=64 -> 8 vregs per word -> 8-way independent chains per VPU op.
+DEFAULT_TILE = 64
+
+
+def _loop_kernel(iterations, sin_ref, out_ref):
+    """One batch tile: run iterations 1..4096 of the PBKDF2 xor-chain.
+
+    ``sin_ref``: uint32[15, TILE, 128] — rows 0-4 the HMAC ipad state,
+    5-9 the opad state, 10-14 U1 (= initial accumulator).
+    ``out_ref``: uint32[5, TILE, 128] — the final T accumulator words.
+    """
+    s = sin_ref[:]
+    ist = tuple(s[i] for i in range(5))
+    ost = tuple(s[5 + i] for i in range(5))
+    u1 = tuple(s[10 + i] for i in range(5))
+
+    def body(_, carry):
+        u, acc = carry[:5], carry[5:]
+        nu = hmac_sha1_20(ist, ost, u)
+        return tuple(nu) + tuple(a ^ x for a, x in zip(acc, nu))
+
+    fin = jax.lax.fori_loop(1, iterations, body, u1 + u1, unroll=False)
+    out_ref[:] = jnp.stack(fin[5:])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("iterations", "tile", "interpret", "prologue_compress")
+)
+def pbkdf2_sha1_pmk_pallas(
+    pw_words,
+    salt1,
+    salt2,
+    *,
+    iterations=4096,
+    tile=DEFAULT_TILE,
+    interpret=False,
+    prologue_compress=None,
+):
+    """Derive 32-byte PMKs for a packed password batch on TPU via Pallas.
+
+    ``pw_words``: uint32[B, 16] zero-padded 64-byte HMAC key blocks
+    (utils/bytesops.pack_passwords_be).  ``salt1``/``salt2``: uint32[16]
+    pre-padded single-block salt messages for ``essid || INT32_BE(i)``
+    (models/m22000.essid_salt_blocks).  Returns uint32[8, B] PMK words,
+    bit-identical to ops/pbkdf2.pbkdf2_sha1_pmk.
+    """
+    B = pw_words.shape[0]
+    pw = [pw_words[:, i] for i in range(16)]
+
+    # Cold prologue (5 compressions of the 8192): pad states + U1, XLA-side.
+    # ``prologue_compress`` lets CPU callers (tests) use the rolled
+    # compression, whose XLA:CPU compile is seconds rather than minutes.
+    kw = {}
+    if prologue_compress is not None:
+        kw = {"compress": prologue_compress}
+    ist, ost = hmac_sha1_precompute(pw, **kw)
+    u1_t1 = hmac_sha1_blocks(ist, ost, [[salt1[i] for i in range(16)]], **kw)
+    u1_t2 = hmac_sha1_blocks(ist, ost, [[salt2[i] for i in range(16)]], **kw)
+
+    # Fold T into lanes: [2B] = T1 lanes then T2 lanes, padded to the tile.
+    lanes = 2 * B
+    step = tile * 128
+    padded = -(-lanes // step) * step
+    rows = (
+        [jnp.concatenate([w, w]) for w in ist]
+        + [jnp.concatenate([w, w]) for w in ost]
+        + [jnp.concatenate([a, b]) for a, b in zip(u1_t1, u1_t2)]
+    )
+    sin = jnp.stack([jnp.pad(r, (0, padded - lanes)) for r in rows])
+    sin = sin.reshape(15, padded // 128, 128)
+
+    out = pl.pallas_call(
+        functools.partial(_loop_kernel, iterations),
+        grid=(padded // step,),
+        in_specs=[
+            pl.BlockSpec((15, tile, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(
+            (5, tile, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((5, padded // 128, 128), jnp.uint32),
+        interpret=interpret,
+    )(sin)
+
+    acc = out.reshape(5, padded)[:, :lanes].reshape(5, 2, B)
+    # PMK = T1 (20 bytes) || T2[:12] -> 8 big-endian words.
+    return jnp.stack(
+        [
+            acc[0, 0], acc[1, 0], acc[2, 0], acc[3, 0], acc[4, 0],
+            acc[0, 1], acc[1, 1], acc[2, 1],
+        ]
+    )

@@ -37,25 +37,41 @@ def sha1_compress(state, block):
     w = list(block)
     a, b, c, d, e = state
 
+    def _xor(x, y):
+        # Fold xors with integer constants at trace time (the 20-byte HMAC
+        # message block is mostly constant padding words).
+        if isinstance(x, int) and isinstance(y, int):
+            return x ^ y
+        if isinstance(x, int) and x == 0:
+            return y
+        if isinstance(y, int) and y == 0:
+            return x
+        return u32(x) ^ u32(y)
+
+    def _rotl(x, n):
+        if isinstance(x, int):
+            return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+        return rotl32(x, n)
+
     for t in range(80):
         if t >= 16:
-            wt = rotl32(
-                u32(w[t - 3]) ^ u32(w[t - 8]) ^ u32(w[t - 14]) ^ u32(w[t - 16]), 1
-            )
-            w.append(wt)
+            w.append(_rotl(_xor(_xor(w[t - 3], w[t - 8]), _xor(w[t - 14], w[t - 16])), 1))
         if t < 20:
-            f = (b & c) | (~b & d)
+            f = d ^ (b & (c ^ d))  # Ch via xor-select: 3 ops vs 4
             k = K0
         elif t < 40:
             f = b ^ c ^ d
             k = K1
         elif t < 60:
-            f = (b & c) | (b & d) | (c & d)
+            f = (b & c) | (d & (b ^ c))  # Maj: 4 ops vs 5
             k = K2
         else:
             f = b ^ c ^ d
             k = K3
-        tmp = rotl32(a, 5) + f + e + u32(k) + u32(w[t])
+        # Group the round constant with constant message words so XLA (or
+        # Python, when w[t] is a literal) folds them into one addend.
+        kw = u32((k + w[t]) & 0xFFFFFFFF) if isinstance(w[t], int) else u32(k) + u32(w[t])
+        tmp = rotl32(a, 5) + f + e + kw
         e = d
         d = c
         c = rotl32(b, 30)

@@ -50,11 +50,17 @@ def build_crack_step(mesh, nets, salt1, salt2):
         hits = jax.lax.psum(jnp.sum(found, dtype=jnp.int32), DP_AXIS)
         return hits, found
 
+    # check_vma=False: the rolled compressions seed their fori_loop carries
+    # from unsharded per-net constants, which fails JAX's varying-manual-axes
+    # check even though every carry is elementwise over the dp-sharded batch
+    # (each device runs the identical closed-over constants against its own
+    # candidate shard, so replication is trivially consistent).
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(DP_AXIS, None),),
         out_specs=(P(), P(None, None, DP_AXIS)),
+        check_vma=False,
     )
     return jax.jit(
         sharded,
