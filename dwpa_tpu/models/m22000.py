@@ -238,8 +238,6 @@ def _pmkid_impl(pmk, msg_block, target):
     return _eq4(out, target)
 
 
-#: pmkid_kernel(pmk[8,B], msg_block[16], target[4]) -> bool[B]
-pmkid_kernel = jax.jit(_pmkid_impl)
 
 
 def eapol_match(pmk, prf_blocks, eapol_blocks, target, *, keyver):
@@ -271,7 +269,6 @@ def eapol_match(pmk, prf_blocks, eapol_blocks, target, *, keyver):
     return jax.vmap(per_variant)(prf_blocks)
 
 
-eapol_kernel = jax.jit(eapol_match, static_argnames=("keyver",))
 
 
 def eapol_cmac_match(pmk, prf_blocks, cmac_full, cmac_last, target, *, last_complete):
@@ -301,7 +298,6 @@ def eapol_cmac_match(pmk, prf_blocks, cmac_full, cmac_last, target, *, last_comp
     return jax.vmap(per_variant)(prf_blocks)
 
 
-eapol_cmac_kernel = jax.jit(eapol_cmac_match, static_argnames=("last_complete",))
 
 
 def net_match(pmk, net: PreppedNet):
@@ -327,37 +323,6 @@ def net_match(pmk, net: PreppedNet):
     )
 
 
-def verify_net(pmk, net: PreppedNet):
-    """Dispatch one prepped net against a PMK batch.
-
-    Returns (found bool[B], variant_idx int[B]) as numpy arrays; for PMKID
-    nets variant_idx is all zeros.
-    """
-    if net.keyver == 100:
-        m = pmkid_kernel(pmk, jnp.asarray(net.pmkid_block), jnp.asarray(net.target))
-        m = np.array(m)
-        return m, np.zeros(m.shape, dtype=np.int32)
-    if net.keyver == 3:
-        mv = eapol_cmac_kernel(
-            pmk,
-            jnp.asarray(net.prf_blocks),
-            jnp.asarray(net.cmac_full),
-            jnp.asarray(net.cmac_last),
-            jnp.asarray(net.cmac_target),
-            last_complete=net.cmac_last_complete,
-        )
-    else:
-        mv = eapol_kernel(
-            pmk,
-            jnp.asarray(net.prf_blocks),
-            jnp.asarray(net.eapol_blocks),
-            jnp.asarray(net.target),
-            keyver=net.keyver,
-        )
-    mv = np.array(mv)  # [V, B]
-    return mv.any(axis=0), mv.argmax(axis=0).astype(np.int32)
-
-
 # ---------------------------------------------------------------------------
 # The engine
 # ---------------------------------------------------------------------------
@@ -381,15 +346,31 @@ class M22000Engine:
     ESSID grouping mirrors the reference scheduler's amortization trick
     (web/content/get_work.php:96-109): one PBKDF2 per (candidate, ESSID)
     feeds the PMKID/MIC checks of every net sharing that ESSID.
+
+    The product path is the mesh-sharded crack step (parallel/step.py):
+    candidates split over the "dp" axis, PBKDF2+verify per shard, and a
+    psum'd scalar hit count fetched as the only per-batch host sync — the
+    full match matrix and PMKs cross to the host only on the rare batch
+    that actually contains a find.  ``mesh="auto"`` spans every local
+    device; a 1-device mesh degenerates to the single-chip path.
     """
 
     def __init__(self, lines, nc: int = DEFAULT_NC, batch_size: int = 4096,
-                 verify_with_oracle: bool = True):
-        self.batch_size = int(batch_size)
+                 verify_with_oracle: bool = True, mesh="auto"):
+        from ..parallel import default_mesh
+
+        if mesh == "auto":
+            mesh = default_mesh()
+        self.mesh = mesh
+        # Pad batches to a multiple of the mesh size (shard_map needs the
+        # candidate axis evenly split).
+        n = mesh.size
+        self.batch_size = -(-int(batch_size) // n) * n
         self.nc = nc
         self.verify_with_oracle = verify_with_oracle
         self.groups = {}  # essid -> list[PreppedNet]
         self.skipped = []
+        self._steps = {}  # essid -> (n_nets, jitted crack step)
         for line in lines:
             try:
                 h = line if isinstance(line, hl.Hashline) else hl.parse(line)
@@ -413,11 +394,20 @@ class M22000Engine:
         if not group:
             del self.groups[found.line.essid]
             del self._salts[found.line.essid]
+            self._steps.pop(found.line.essid, None)
 
-    def pmk_batch(self, essid: bytes, pw_words) -> jax.Array:
-        """PBKDF2 a packed password batch for one ESSID -> uint32[8, B]."""
-        s1, s2 = self._salts.get(essid) or essid_salt_blocks(essid)
-        return pmk_kernel(jnp.asarray(pw_words), jnp.asarray(s1), jnp.asarray(s2))
+    def _step_for(self, essid: bytes, group: list):
+        """The jitted mesh crack step for one ESSID group (cached until
+        the group shrinks after a find)."""
+        from ..parallel import build_crack_step
+
+        cached = self._steps.get(essid)
+        if cached and cached[0] == len(group):
+            return cached[1]
+        s1, s2 = self._salts[essid]
+        step = build_crack_step(self.mesh, list(group), s1, s2)
+        self._steps[essid] = (len(group), step)
+        return step
 
     def crack_batch(self, passwords) -> list:
         """One fixed-size batch of candidate byte-strings -> list[Found]."""
@@ -428,24 +418,33 @@ class M22000Engine:
         if not pws:
             return []
         nvalid = len(pws)
-        if nvalid < self.batch_size:
-            pws = pws + [b"\x00" * MIN_PSK_LEN] * (self.batch_size - nvalid)
-        pw_words = bo.pack_passwords_be(pws)
+        # Pad to batch_size (or, for an oversize caller-supplied batch, up
+        # to the next mesh-size multiple so the shard_map split stays even).
+        target = max(self.batch_size, -(-nvalid // self.mesh.size) * self.mesh.size)
+        if nvalid < target:
+            pws = pws + [b"\x00" * MIN_PSK_LEN] * (target - nvalid)
+        from ..parallel import shard_candidates
+
+        pw_words = shard_candidates(self.mesh, bo.pack_passwords_be(pws))
         founds = []
         for essid, group in list(self.groups.items()):
-            pmk = self.pmk_batch(essid, pw_words)
-            pmk_host = None
-            for net in list(group):
-                found, vidx = verify_net(pmk, net)
-                found[nvalid:] = False
-                if not found.any():
-                    continue
-                if pmk_host is None:
-                    pmk_host = np.asarray(pmk)
-                for b in np.flatnonzero(found):
+            step = self._step_for(essid, group)
+            hits, found_dev, pmk_dev = step(pw_words)
+            # The psum hits-gate: one replicated scalar is the only
+            # device->host sync on the (overwhelmingly common) all-miss
+            # batch; the [N, V, B] matrix and PMKs stay on device.
+            if int(np.asarray(hits)) == 0:
+                continue
+            found = np.array(found_dev)  # [N, V_max, B] (host copy, writable)
+            found[:, :, nvalid:] = False
+            pmk_host = np.asarray(pmk_dev)
+            for ni, net in enumerate(list(group)):
+                nf = found[ni]  # [V_max, B]
+                hit_cols = np.flatnonzero(nf.any(axis=0))
+                for b in hit_cols:
                     delta, endian = (0, None)
                     if net.keyver != 100:
-                        delta, endian = net.variants[int(vidx[b])]
+                        delta, endian = net.variants[int(nf[:, b].argmax())]
                     pmk_bytes = bo.words_to_bytes_be(pmk_host[:, b])
                     if self.verify_with_oracle:
                         chk = oracle.check_key_m22000(net.line, [pws[b]], nc=self.nc)

@@ -92,7 +92,10 @@ def pbkdf2_sha1_pmk_pallas(
     u1_t2 = hmac_sha1_blocks(ist, ost, [[salt2[i] for i in range(16)]], **kw)
 
     # Fold T into lanes: [2B] = T1 lanes then T2 lanes, padded to the tile.
+    # Clamp the tile to the actual lane count (min 8 sublanes — the uint32
+    # tiling floor) so small per-device shards don't pad 8x dead work.
     lanes = 2 * B
+    tile = max(8, min(tile, -(-lanes // 128)))
     step = tile * 128
     padded = -(-lanes // step) * step
     rows = (

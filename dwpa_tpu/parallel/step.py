@@ -27,16 +27,20 @@ def build_crack_step(mesh, nets, salt1, salt2):
     """Jit the full crack step for one ESSID group over ``mesh``.
 
     ``nets``: list of PreppedNet sharing one ESSID (constants are folded
-    into the trace).  Returns ``step(pw_words[B,16]) -> (hits[], found)``
-    where ``found`` is bool[N, V_max, B] (variant axes zero-padded so the
-    per-net matrices stack; B must be divisible by the mesh size).
+    into the trace).  Returns ``step(pw_words[B,16]) -> (hits[], found,
+    pmk)`` where ``found`` is bool[N, V_max, B] (variant axes zero-padded
+    so the per-net matrices stack) and ``pmk`` is uint32[8, B]; B must be
+    divisible by the mesh size.  The host should gate on the replicated
+    scalar ``hits`` and only fetch ``found``/``pmk`` for the rare
+    positives (the psum hits-gate, SURVEY.md §5.7).
     """
     s1 = jnp.asarray(salt1)
     s2 = jnp.asarray(salt2)
     v_max = max(1 if n.keyver == 100 else len(n.variants) for n in nets)
+    use_pallas = all(d.platform == "tpu" for d in mesh.devices.flat)
 
     def local_step(pw_words):
-        pmk = m._pmk_impl(pw_words, s1, s2)
+        pmk = m._pmk_impl(pw_words, s1, s2, use_pallas=use_pallas)
         per_net = []
         for net in nets:
             mv = m.net_match(pmk, net)  # [V, b]
@@ -48,7 +52,7 @@ def build_crack_step(mesh, nets, salt1, salt2):
             per_net.append(mv)
         found = jnp.stack(per_net)  # [N, V_max, b]
         hits = jax.lax.psum(jnp.sum(found, dtype=jnp.int32), DP_AXIS)
-        return hits, found
+        return hits, found, pmk
 
     # check_vma=False: the rolled compressions seed their fori_loop carries
     # from unsharded per-net constants, which fails JAX's varying-manual-axes
@@ -59,7 +63,7 @@ def build_crack_step(mesh, nets, salt1, salt2):
         local_step,
         mesh=mesh,
         in_specs=(P(DP_AXIS, None),),
-        out_specs=(P(), P(None, None, DP_AXIS)),
+        out_specs=(P(), P(None, None, DP_AXIS), P(None, DP_AXIS)),
         check_vma=False,
     )
     return jax.jit(

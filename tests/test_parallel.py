@@ -47,8 +47,13 @@ def test_crack_step_on_8_device_mesh():
     batch = 16
     pws = _batch(batch)
     pw_words = shard_candidates(mesh, bo.pack_passwords_be(pws))
-    hits, found = jax.block_until_ready(step(pw_words))
+    hits, found, pmk = jax.block_until_ready(step(pw_words))
     assert int(hits) == 3  # one match per net (exact, exact, NC+3)
+    # the sharded PMK comes back reassembled and matches the oracle
+    from dwpa_tpu.oracle.m22000 import pmk_from_psk
+
+    got = bo.words_to_bytes_be(np.array(pmk)[:, batch // 2])
+    assert got == pmk_from_psk(PSK, ESSID)
     found = np.array(found)
     # the planted PSK's column holds every hit; no other column matches
     assert found[:, :, batch // 2].any(axis=1).all()
@@ -65,10 +70,36 @@ def test_crack_step_matches_single_device():
 
     mesh8 = default_mesh()
     step8 = build_crack_step(mesh8, nets, s1, s2)
-    _, found8 = step8(shard_candidates(mesh8, pw_words))
+    _, found8, _ = step8(shard_candidates(mesh8, pw_words))
 
     mesh1 = default_mesh(n=1)
     step1 = build_crack_step(mesh1, nets, s1, s2)
-    _, found1 = step1(shard_candidates(mesh1, pw_words))
+    _, found1, _ = step1(shard_candidates(mesh1, pw_words))
 
     np.testing.assert_array_equal(np.array(found8), np.array(found1))
+
+
+def test_engine_identical_founds_on_1_and_8_device_mesh():
+    """The engine product path produces the same founds on any mesh."""
+    lines = [
+        T.make_pmkid_line(PSK, ESSID, seed="me1"),
+        T.make_eapol_line(PSK, ESSID, keyver=2, nc_delta=2, endian="BE", seed="me2"),
+    ]
+    results = {}
+    for n in (1, 8):
+        eng = m.M22000Engine(lines, batch_size=16, mesh=default_mesh(n=n))
+        founds = eng.crack(_batch(16))
+        results[n] = sorted(
+            (f.line.pmkid_or_mic, f.psk, f.nc, f.endian, f.pmk) for f in founds
+        )
+    assert len(results[1]) == 2
+    assert results[1] == results[8]
+
+
+def test_engine_oversize_batch_pads_to_mesh_multiple():
+    """A caller-supplied batch larger than batch_size still shards evenly."""
+    lines = [T.make_pmkid_line(PSK, ESSID, seed="ob1")]
+    eng = m.M22000Engine(lines, batch_size=8, mesh=default_mesh())
+    pws = _batch(16) + [b"extra-%02d" % i for i in range(4)]  # 20 candidates
+    founds = eng.crack_batch(pws)
+    assert [f.psk for f in founds] == [PSK]
