@@ -38,20 +38,36 @@ class DictStream:
         self.limit = limit
 
     def _open(self):
+        """-> (fileobj, owned_raw) — only close files this stream opened
+        itself, so a caller-supplied fileobj stays usable for re-iteration
+        (seekable sources are rewound instead)."""
         if isinstance(self.source, (str, bytes)):
             f = open(self.source, "rb")
+            owns = True
         else:
             f = self.source
-        head = f.peek(2) if hasattr(f, "peek") else b""
-        if isinstance(f, io.BufferedReader) and head[:2] == b"\x1f\x8b":
-            return gzip.open(f)
-        if isinstance(self.source, (str, bytes)) and str(self.source).endswith(".gz"):
-            return gzip.open(f)
-        return f
+            owns = False
+            if getattr(f, "seekable", lambda: False)():
+                f.seek(0)
+        # Sniff gzip on any peekable or seekable object, not just
+        # BufferedReader.
+        head = b""
+        if hasattr(f, "peek"):
+            head = f.peek(2)[:2]
+        elif getattr(f, "seekable", lambda: False)():
+            head = f.read(2)
+            f.seek(0)
+        if head == b"\x1f\x8b" or (
+            isinstance(self.source, (str, bytes))
+            and str(self.source).endswith(".gz")
+        ):
+            return gzip.open(f), (f if owns else None)
+        return f, (f if owns else None)
 
     def __iter__(self):
         n = 0
-        with self._open() as f:
+        f, owned_raw = self._open()
+        try:
             for i, line in enumerate(f):
                 if i < self.skip:
                     continue
@@ -61,6 +77,11 @@ class DictStream:
                 if word:
                     n += 1
                     yield word
+        finally:
+            if owned_raw is not None:
+                if f is not owned_raw:
+                    f.close()  # the gzip wrapper
+                owned_raw.close()
 
     def batches(self, size: int):
         """Yield lists of up to ``size`` words."""

@@ -40,10 +40,18 @@ def _version_ok(ver: str) -> bool:
         return False
 
 
+class BodyTooLarge(Exception):
+    """Request body exceeds the cap — reject, never silently truncate."""
+
+
 def make_wsgi_app(core: ServerCore):
     def app(environ, start_response):
         try:
             status, ctype, body = _route(core, environ)
+        except BodyTooLarge:
+            status, ctype, body = (
+                "413 Content Too Large", "text/plain", b"capture too large",
+            )
         except ValueError as e:
             status, ctype, body = "400 Bad Request", "text/plain", str(e).encode()
         start_response(status, [("Content-Type", ctype),
@@ -58,7 +66,11 @@ def _read_body(environ, cap=64 * 1024 * 1024) -> bytes:
         n = int(environ.get("CONTENT_LENGTH") or 0)
     except ValueError:
         n = 0
-    return environ["wsgi.input"].read(min(n, cap)) if n else b""
+    if n < 0:
+        n = 0  # a negative length would make read() slurp the stream
+    if n > cap:
+        raise BodyTooLarge(n)
+    return environ["wsgi.input"].read(n) if n else b""
 
 
 def _route(core: ServerCore, environ):

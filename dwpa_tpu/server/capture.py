@@ -150,6 +150,10 @@ def _parse_eapol_key(ap: bytes, sta: bytes, eapol: bytes):
     # 802.1X: ver(1) type(1) len(2); EAPOL-Key descriptor follows
     if len(eapol) < 95 + 4 or eapol[1] != 3:
         return None
+    # Descriptor type must be RSN (2) or WPA (254); other 802.1X type-3
+    # packets can carry a coincidental pairwise bit (hcxpcapngtool checks).
+    if eapol[4] not in (2, 254):
+        return None
     ki = struct.unpack_from(">H", eapol, 5)[0]
     if not ki & KI_PAIRWISE:
         return None

@@ -277,11 +277,18 @@ class ServerCore:
             return False
         for pair in cands[:MAX_CANDS_PER_PUT]:
             k, v = pair.get("k"), pair.get("v")
-            if not isinstance(k, str) or not isinstance(v, str):
+            if not isinstance(k, str) or not isinstance(v, str) or v == "":
                 continue
-            try:
-                psk = bytes.fromhex(v)
-            except ValueError:
+            # Candidate encoding depends on the claim type (common.php:
+            # 874-898): bssid/ssid claims carry hex2bin'd PSKs, while
+            # 'hash' claims carry raw text (hc_unhex'd by the verifier) —
+            # a raw all-digit PSK must NOT be hex-decoded here.
+            if ctype in ("bssid", "ssid"):
+                try:
+                    psk = bytes.fromhex(v)
+                except ValueError:
+                    continue
+            else:
                 psk = oracle.hc_unhex(v)
             for net in self._nets_for_claim(ctype, k):
                 self._try_accept(net, psk, submitter=data.get("ip", ""))
@@ -299,9 +306,13 @@ class ServerCore:
                 "SELECT * FROM nets WHERE bssid = ? AND n_state = 0", (b,)
             )
         if ctype == "ssid":
+            # The ssid claim key arrives hex-encoded (common.php:886-887).
+            try:
+                essid = bytes.fromhex(key)
+            except ValueError:
+                return []
             return self.db.q(
-                "SELECT * FROM nets WHERE ssid = ? AND n_state = 0",
-                (key.encode("latin1", "ignore"),),
+                "SELECT * FROM nets WHERE ssid = ? AND n_state = 0", (essid,)
             )
         if ctype == "hash":
             try:

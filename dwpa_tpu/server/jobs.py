@@ -21,6 +21,7 @@ import hashlib
 import os
 import time
 
+from ..gen.dicts import md5_file
 from ..gen.psktool import psk_candidates
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
@@ -107,9 +108,12 @@ def regen_cracked_dict(core: ServerCore, path: str) -> int:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     data = b"\n".join(words) + (b"\n" if words else b"")
     with open(path, "wb") as f:
-        f.write(gzip.compress(data, 9))
+        # mtime=0 -> deterministic bytes, so dhash (and every client's
+        # cached copy) only changes when the word list itself changes.
+        with gzip.GzipFile(fileobj=f, mode="wb", compresslevel=9, mtime=0) as gz:
+            gz.write(data)
     # update/insert the dict row so the scheduler hands it out
-    dhash = hashlib.md5(open(path, "rb").read()).hexdigest()
+    dhash = md5_file(path)
     dname = os.path.basename(path)
     row = core.db.q1("SELECT d_id FROM dicts WHERE dname = ?", (dname,))
     if row:

@@ -1,0 +1,232 @@
+"""Loopback client <-> server integration tests.
+
+Runs TpuCrackClient against make_wsgi_app entirely in-process: a
+ServerAPI whose ``fetch`` invokes the WSGI app directly, so the complete
+reference flow (help_crack.py:881-957) — challenge gate, get_work, dict
+download + md5 check, two-pass crack, put_work, resume replay, autotune —
+is exercised over the exact wire protocol with no sockets.
+"""
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import urllib.parse
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+from dwpa_tpu.client.protocol import NoNets, ServerAPI, VersionRejected
+from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+PSK = b"loopback-psk1"
+ESSID = b"LoopbackNet"
+
+
+class LoopbackAPI(ServerAPI):
+    """ServerAPI whose transport is a direct WSGI call (no sockets)."""
+
+    def __init__(self, app, **kw):
+        kw.setdefault("max_tries", 1)
+        kw.setdefault("sleep", lambda s: None)
+        super().__init__("http://loopback/", **kw)
+        self.app = app
+        self.requests = []
+
+    def fetch(self, url: str, data: dict = None) -> bytes:
+        parsed = urllib.parse.urlparse(url)
+        body = json.dumps(data).encode() if data is not None else b""
+        environ = {
+            "REQUEST_METHOD": "POST" if data is not None else "GET",
+            "PATH_INFO": parsed.path or "/",
+            "QUERY_STRING": parsed.query,
+            "CONTENT_LENGTH": str(len(body)),
+            "wsgi.input": io.BytesIO(body),
+            "REMOTE_ADDR": "127.0.0.1",
+        }
+        out = {}
+
+        def start_response(status, headers):
+            out["status"] = status
+
+        resp = b"".join(self.app(environ, start_response))
+        self.requests.append((environ["REQUEST_METHOD"], url))
+        if not out["status"].startswith("200"):
+            raise ConnectionError(f"{url}: {out['status']}")
+        return resp
+
+
+@pytest.fixture
+def server(tmp_path):
+    db = Database(":memory:")
+    core = ServerCore(db, dictdir=str(tmp_path / "dicts"), capdir=str(tmp_path / "caps"))
+    return core
+
+
+def _add_dict(core, words, name="loop.txt.gz"):
+    os.makedirs(core.dictdir, exist_ok=True)
+    blob = gzip.compress(b"\n".join(words) + b"\n")
+    path = os.path.join(core.dictdir, name)
+    with open(path, "wb") as f:
+        f.write(blob)
+    dhash = hashlib.md5(blob).hexdigest()
+    core.add_dict(f"dict/{name}", name, dhash, len(words), rules=None)
+    return path, dhash
+
+
+def _ingest(core, lines):
+    core.add_hashlines(lines)
+    core.db.x("UPDATE nets SET algo = ''")  # release to volunteers
+
+
+def _client(server, tmp_path, **cfg_kw):
+    cfg_kw.setdefault("batch_size", 64)
+    cfg_kw.setdefault("dictcount", 1)
+    cfg = ClientConfig(base_url="http://loopback/",
+                       workdir=str(tmp_path / "work"), **cfg_kw)
+    api = LoopbackAPI(make_wsgi_app(server))
+    return TpuCrackClient(cfg, api=api, log=lambda *a, **k: None)
+
+
+def test_full_round_trip(server, tmp_path):
+    """get_work -> crack -> put_work: the net ends cracked server-side,
+    the potfile records the found, and the lease is closed."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="rt1"),
+                     tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="rt2")])
+    _add_dict(server, [b"nope-000001", PSK, b"nope-000002"])
+    client = _client(server, tmp_path)
+
+    assert client.challenge()
+    work = client.api.get_work(client.dictcount)
+    assert len(work["hashes"]) == 2  # same-SSID nets grouped into one unit
+    res = client.process_work(work)
+
+    assert res.accepted
+    assert sorted(f.psk for f in res.founds) == [PSK, PSK]
+    rows = server.db.q("SELECT n_state, pass FROM nets")
+    assert all(r["n_state"] == 1 and r["pass"] == PSK for r in rows)
+    assert server.db.q1("SELECT COUNT(*) c FROM n2d WHERE hkey IS NOT NULL")["c"] == 0
+    # potfile written, resume cleared
+    pot = open(client.potfile).read()
+    assert PSK.decode() in pot
+    assert not os.path.exists(client.resume_path)
+
+
+def test_run_loop_with_challenge_gate(server, tmp_path):
+    """client.run(): challenge gate passes, one unit processed end-to-end."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="rl1")])
+    _add_dict(server, [PSK])
+    client = _client(server, tmp_path, max_work_units=1)
+    assert client.run() == 1
+    assert server.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+
+def test_resume_replay_after_crash(server, tmp_path):
+    """A resume snapshot from a crashed session is replayed instead of
+    fetching new work (help_crack.py:745-763)."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="rr1")])
+    _add_dict(server, [PSK])
+    crashed = _client(server, tmp_path)
+    work = crashed.api.get_work(1)
+    crashed._write_resume(work)  # simulated crash: resume left behind
+
+    revived = _client(server, tmp_path)
+
+    def fail_get_work(dictcount):
+        raise AssertionError("must replay the resume, not fetch new work")
+
+    revived.api.get_work = fail_get_work
+    revived.cfg.max_work_units = 1
+    # run() skips the challenge here? No — challenge still gates; keep it.
+    assert revived.challenge()
+    replayed = revived._read_resume()
+    assert replayed == work
+    res = revived.process_work(replayed)
+    assert res.accepted
+    assert not os.path.exists(revived.resume_path)
+
+
+def test_corrupt_resume_discarded(server, tmp_path):
+    client = _client(server, tmp_path)
+    with open(client.resume_path, "w") as f:
+        f.write("{not json")
+    assert client._read_resume() is None
+    assert not os.path.exists(client.resume_path)
+
+
+def test_dict_md5_mismatch_rejected(server, tmp_path):
+    """A corrupted dict download fails the md5 gate (help_crack.py:533-534)."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="md5-1")])
+    path, dhash = _add_dict(server, [PSK])
+    with open(path, "ab") as f:
+        f.write(b"corruption\n")  # server file changes after registration
+    client = _client(server, tmp_path)
+    work = client.api.get_work(1)
+    with pytest.raises(ValueError, match="md5 mismatch"):
+        client._fetch_dicts(work)
+
+
+def test_autotune_moves_dictcount(server, tmp_path):
+    client = _client(server, tmp_path)
+    client.cfg.pace_target = 1e9  # everything is "fast"
+    for _ in range(20):
+        client._autotune(elapsed=1.0)
+    assert client.dictcount == 15  # clamped at the reference cap
+    client.cfg.pace_target = 0.0  # everything is "slow"
+    for _ in range(20):
+        client._autotune(elapsed=1.0)
+    assert client.dictcount == 1
+
+
+def test_challenge_gate_failure_exits(server, tmp_path, monkeypatch):
+    """A cracker that cannot reproduce the known PSK must not fetch work
+    (help_crack.py:886-895)."""
+    client = _client(server, tmp_path)
+
+    class BrokenEngine:
+        def __init__(self, *a, **k):
+            self.groups = {}
+
+        def crack(self, words):
+            return []
+
+    import dwpa_tpu.client.main as cm
+
+    monkeypatch.setattr(cm, "M22000Engine", BrokenEngine)
+    with pytest.raises(SystemExit):
+        client.run()
+
+
+def test_version_gate_and_no_nets(server, tmp_path):
+    app = make_wsgi_app(server)
+    old = LoopbackAPI(app, hc_ver="2.0.0")
+    with pytest.raises(VersionRejected):
+        old.get_work(1)
+    empty = LoopbackAPI(app)
+    with pytest.raises(NoNets):
+        empty.get_work(1)
+
+
+def test_prdict_pass1_candidates(server, tmp_path):
+    """The dynamic PROBEREQUEST dict feeds pass 1 (help_crack.py:557-568):
+    a PSK present only as a probed SSID in the same capture still cracks
+    the unit even though no server dict contains it."""
+    probed_psk = b"ProbedNetwork1"
+    # One capture: the handshake's PSK is also some station's probed SSID.
+    blob, _ = tfx.make_handshake_capture(probed_psk, ESSID, probes=[probed_psk])
+    from dwpa_tpu.server.api import submit_capture
+
+    submit_capture(server, blob)
+    server.db.x("UPDATE nets SET algo = ''")
+    _add_dict(server, [b"filler-word-1"])  # server dict does NOT contain it
+
+    client = _client(server, tmp_path)
+    work = client.api.get_work(1)
+    assert work.get("prdict") is True
+    res = client.process_work(work)
+    assert any(f.psk == probed_psk for f in res.founds)
+    rows = server.db.q("SELECT n_state, pass FROM nets")
+    assert all(r["n_state"] == 1 and r["pass"] == probed_psk for r in rows)

@@ -152,3 +152,25 @@ def test_dict_stream(tmp_path):
 def test_dict_stream_fileobj():
     buf = io.BufferedReader(io.BytesIO(b"one1234\ntwo5678\n"))
     assert list(DictStream(buf)) == [b"one1234", b"two5678"]
+
+
+def test_dictstream_reiterates_caller_fileobj():
+    """A caller-supplied fileobj survives iteration and can be re-read
+    (ADVICE r1: DictStream used to close it after the first pass)."""
+    import io
+    from dwpa_tpu.gen.dicts import DictStream
+
+    buf = io.BytesIO(b"alpha\nbeta\n\ngamma\n")
+    ds = DictStream(buf)
+    assert list(ds) == [b"alpha", b"beta", b"gamma"]
+    assert list(ds) == [b"alpha", b"beta", b"gamma"]
+    assert not buf.closed
+
+
+def test_dictstream_sniffs_gzip_bytesio():
+    import gzip, io
+    from dwpa_tpu.gen.dicts import DictStream
+
+    buf = io.BytesIO(gzip.compress(b"one\ntwo\n"))
+    assert list(DictStream(buf)) == [b"one", b"two"]
+    assert list(DictStream(buf)) == [b"one", b"two"]

@@ -306,3 +306,90 @@ def test_wsgi_endpoints(core):
     maintenance(core)
     status, body = _call(app, qs="stats")
     assert json.loads(body)["cracked"] >= 1
+
+
+def test_put_work_hash_type_raw_digit_psk(core):
+    """'hash' claims carry raw-text PSKs: an all-digit key (valid hex!)
+    must not be hex-decoded (ADVICE r1; common.php:890-898)."""
+    digit_psk = b"12345678"
+    line = tfx.make_pmkid_line(digit_psk, ESSID, seed="hash-claim")
+    core.add_hashlines([line])
+    nhash = core.db.q1("SELECT hash FROM nets")["hash"]
+    core.put_work({"type": "hash",
+                   "cand": [{"k": nhash.hex(), "v": digit_psk.decode()}]})
+    row = core.db.q1("SELECT n_state, pass FROM nets")
+    assert row["n_state"] == 1 and row["pass"] == digit_psk
+
+
+def test_put_work_hash_type_hex_notation(core):
+    """'hash' claims may use hashcat $HEX[...] notation for binary PSKs."""
+    psk = b"caf\xc3\xa9pass"  # 'café' in utf-8 + suffix
+    line = tfx.make_pmkid_line(psk, ESSID, seed="hex-claim")
+    core.add_hashlines([line])
+    nhash = core.db.q1("SELECT hash FROM nets")["hash"]
+    core.put_work({"type": "hash",
+                   "cand": [{"k": nhash.hex(), "v": "$HEX[%s]" % psk.hex()}]})
+    assert core.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+
+def test_put_work_ssid_type_hex_key(core):
+    """ssid claims: key is the hex-encoded ESSID, value a hex PSK
+    (common.php:886-887)."""
+    line = tfx.make_pmkid_line(PSK, ESSID, seed="ssid-claim")
+    core.add_hashlines([line])
+    core.put_work({"type": "ssid",
+                   "cand": [{"k": ESSID.hex(), "v": PSK.hex()}]})
+    assert core.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+
+def test_wsgi_oversized_body_rejected_413(core):
+    """Oversized uploads are rejected outright, never truncated+ingested."""
+    app = make_wsgi_app(core)
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = status
+
+    environ = {
+        "REQUEST_METHOD": "POST",
+        "PATH_INFO": "/",
+        "QUERY_STRING": "",
+        "CONTENT_LENGTH": str(65 * 1024 * 1024),
+        "wsgi.input": io.BytesIO(b"x"),
+        "REMOTE_ADDR": "9.9.9.9",
+    }
+    b"".join(app(environ, start_response))
+    assert out["status"].startswith("413")
+    assert core.db.q1("SELECT COUNT(*) c FROM submissions")["c"] == 0
+
+
+def test_regen_cracked_dict_deterministic(core, tmp_path):
+    """Identical content -> identical gzip bytes (mtime=0), so dhash and
+    client caches only churn when the word list changes."""
+    from dwpa_tpu.server.jobs import regen_cracked_dict
+
+    line = tfx.make_pmkid_line(PSK, ESSID, seed="regen")
+    core.add_hashlines([line])
+    nhash = core.db.q1("SELECT hash FROM nets")["hash"]
+    core.put_work({"type": "hash", "cand": [{"k": nhash.hex(), "v": PSK.decode()}]})
+    path = str(tmp_path / "cracked.txt.gz")
+    regen_cracked_dict(core, path)
+    first = open(path, "rb").read()
+    regen_cracked_dict(core, path)
+    assert open(path, "rb").read() == first
+
+
+def test_eapol_descriptor_type_gate():
+    """802.1X type-3 frames with a non-RSN/WPA descriptor type must not be
+    parsed as handshake messages."""
+    from dwpa_tpu.server.capture import _parse_eapol_key
+
+    # craft a bogus EAPOL-Key frame: correct shape, descriptor type 1
+    import struct as _s
+    body = bytearray(99)
+    body[1] = 3  # 802.1X packet type: EAPOL-Key
+    body[4] = 1  # descriptor type: RC4 (not 2/254)
+    _s.pack_into(">H", body, 5, 0x010A)  # pairwise|mic
+    assert _parse_eapol_key(b"\xaa" * 6, b"\xbb" * 6, bytes(body)) is None
+    body[4] = 2
+    assert _parse_eapol_key(b"\xaa" * 6, b"\xbb" * 6, bytes(body)) is not None
