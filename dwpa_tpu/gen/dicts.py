@@ -78,9 +78,9 @@ class DictStream:
                     n += 1
                     yield word
         finally:
+            if f is not self.source and f is not owned_raw:
+                f.close()  # the gzip wrapper (never closes the underlying)
             if owned_raw is not None:
-                if f is not owned_raw:
-                    f.close()  # the gzip wrapper
                 owned_raw.close()
 
     def batches(self, size: int):

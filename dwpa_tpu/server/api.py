@@ -47,21 +47,35 @@ class BodyTooLarge(Exception):
 def make_wsgi_app(core: ServerCore):
     def app(environ, start_response):
         try:
-            status, ctype, body = _route(core, environ)
+            out = _route(core, environ)
         except BodyTooLarge:
-            status, ctype, body = (
-                "413 Content Too Large", "text/plain", b"capture too large",
-            )
+            out = ("413 Content Too Large", "text/plain", b"capture too large")
         except ValueError as e:
-            status, ctype, body = "400 Bad Request", "text/plain", str(e).encode()
+            out = ("400 Bad Request", "text/plain", str(e).encode())
+        status, ctype, body = out[:3]
+        extra_headers = list(out[3]) if len(out) > 3 else []
         start_response(status, [("Content-Type", ctype),
-                                ("Content-Length", str(len(body)))])
+                                ("Content-Length", str(len(body)))]
+                       + extra_headers)
         return [body]
 
     return app
 
 
+def _set_key_cookie(key: str):
+    return [("Set-Cookie", f"key={key}; Max-Age=2147483647; HttpOnly")]
+
+
+def _clear_key_cookie():
+    return [("Set-Cookie", "key=; Max-Age=0; HttpOnly")]
+
+
 def _read_body(environ, cap=64 * 1024 * 1024) -> bytes:
+    # Cached: the UI router may parse the body as a form and fall through
+    # to the capture path — re-reading a socket-backed wsgi.input past the
+    # request body would block the worker.
+    if "dwpa.body" in environ:
+        return environ["dwpa.body"]
     try:
         n = int(environ.get("CONTENT_LENGTH") or 0)
     except ValueError:
@@ -70,7 +84,9 @@ def _read_body(environ, cap=64 * 1024 * 1024) -> bytes:
         n = 0  # a negative length would make read() slurp the stream
     if n > cap:
         raise BodyTooLarge(n)
-    return environ["wsgi.input"].read(n) if n else b""
+    body = environ["wsgi.input"].read(n) if n else b""
+    environ["dwpa.body"] = body
+    return body
 
 
 def _route(core: ServerCore, environ):
@@ -117,12 +133,17 @@ def _route(core: ServerCore, environ):
         lines = core.user_potfile(key)
         return "200 OK", "text/plain", ("\n".join(lines) + "\n").encode()
 
-    if "stats" in qs:
+    if "stats" in qs and "text/html" not in environ.get("HTTP_ACCEPT", ""):
         rows = core.db.q("SELECT name, value FROM stats")
         return (
             "200 OK", "application/json",
             json.dumps({r["name"]: r["value"] for r in rows}).encode(),
         )
+
+    # ---- browser surface (HTML CMS + user-key actions) -------------------
+    resp = _route_ui(core, environ, qs)
+    if resp is not None:
+        return resp
 
     if environ["REQUEST_METHOD"] == "POST":
         # capture submission (multipart not required: raw body accepted,
@@ -136,6 +157,124 @@ def _route(core: ServerCore, environ):
         return "200 OK", "application/json", json.dumps(report).encode()
 
     return "200 OK", "text/plain", b"dwpa_tpu server"
+
+
+UI_KEYS = ("home", "get_key", "my_nets", "submit", "nets", "dicts", "stats",
+           "search")
+
+
+def _route_ui(core: ServerCore, environ, qs):
+    """The human-facing CMS (web/index.php:12-163 + web/content/*.php).
+
+    Returns a response tuple, or None to fall through to the machine
+    catch-alls.  POST bodies here are urlencoded forms; raw/multipart
+    bodies stay on the capture-upload path.
+    """
+    from . import ui
+    from .core import valid_email, valid_key
+
+    method = environ["REQUEST_METHOD"]
+    form = {}
+    if method == "POST" and environ.get("CONTENT_TYPE", "").startswith(
+        "application/x-www-form-urlencoded"
+    ):
+        form = {
+            k: v[0]
+            for k, v in urllib.parse.parse_qs(
+                _read_body(environ).decode("utf-8", "replace"),
+                keep_blank_values=True,
+            ).items()
+        }
+
+    # -- key set / remove (index.php:109-142) --
+    if "key" in form:
+        k = form["key"].lower()
+        if valid_key(k) and (
+            (core.bosskey and k == core.bosskey) or core.user_key_exists(k)
+        ):
+            return ("302 Found", "text/plain", b"",
+                    [("Location", "/")] + _set_key_cookie(k))
+        return ("302 Found", "text/plain", b"",
+                [("Location", "/")] + _clear_key_cookie())
+    if "remkey" in form:
+        return ("302 Found", "text/plain", b"",
+                [("Location", "/")] + _clear_key_cookie())
+
+    # -- key issue (index.php:14-102): optional captcha seam, then mail --
+    if "mail" in form:
+        ip = environ.get("REMOTE_ADDR", "")
+        if core.captcha and not core.captcha(
+            form.get("g-recaptcha-response", ""), ip
+        ):
+            return ("200 OK", "text/html",
+                    ui.render(ui.page_get_key("Captcha validation failed.")))
+        mail = form["mail"].strip()
+        if not valid_email(mail):
+            return ("200 OK", "text/html",
+                    ui.render(ui.page_get_key("No valid e-mail provided!")))
+        status, key = core.issue_user_key(mail, ip=ip)
+        if status == "issued":
+            return ("200 OK", "text/html",
+                    ui.render(ui.page_get_key(
+                        "User key issued. Make sure you keep it to access "
+                        "the results.")),
+                    _set_key_cookie(key))
+        if status == "reset":
+            return ("200 OK", "text/html",
+                    ui.render(ui.page_get_key(
+                        "New key request was submitted. Please check your "
+                        "e-mail to confirm.")))
+        return ("200 OK", "text/html",
+                ui.render(ui.page_get_key(
+                    "User key request was already submitted. Please try "
+                    "again tomorrow.")))
+
+    # -- linkkey confirmation (get_key.php:11-31) --
+    if "get_key" in qs and valid_key(qs["get_key"][0].lower()):
+        lk = qs["get_key"][0].lower()
+        if core.confirm_linkkey(lk):
+            return ("302 Found", "text/plain", b"",
+                    [("Location", "/")] + _set_key_cookie(lk))
+        return ("200 OK", "text/html",
+                ui.render(ui.page_get_key("User key NOT set.")))
+
+    page = next((k for k in UI_KEYS if k in qs), None)
+    if page is None:
+        return None
+
+    viewer = ui.resolve_viewer(core, _cookie_key(environ))
+
+    # -- crowdsourced PSK guesses on nets/search/my_nets (build_cand,
+    #    common.php:39-53; nets.php:6-8) --
+    cand = [{"k": k, "v": v} for k, v in form.items()
+            if valid_key(k) and v.strip()]
+    if cand:
+        core.put_work({"type": "hash", "cand": cand,
+                       "ip": environ.get("REMOTE_ADDR", "")})
+
+    if page == "nets":
+        body = ui.page_nets(core, viewer)
+    elif page == "search":
+        # ?search&search=<term>: the page key and the term share the name
+        # (PHP keeps the last duplicate, search.php:13-15)
+        body = ui.page_search(core, viewer, qs.get("search", [""])[-1])
+    elif page == "my_nets":
+        try:
+            pageno = int(qs.get("page", ["1"])[0])
+        except ValueError:
+            pageno = 1
+        body = ui.page_my_nets(core, viewer, pageno)
+    elif page == "stats":
+        body = ui.page_stats(core)
+    elif page == "dicts":
+        body = ui.page_dicts(core)
+    elif page == "submit":
+        body = ui.page_submit()
+    elif page == "get_key":
+        body = ui.page_get_key(has_key=bool(viewer.key))
+    else:
+        body = ui.page_home()
+    return "200 OK", "text/html", ui.render(body)
 
 
 def _cookie_key(environ) -> str:

@@ -25,7 +25,9 @@ device verification belongs to the client side.
 import base64
 import hashlib
 import os
+import re
 import secrets
+import sqlite3
 
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
@@ -42,11 +44,33 @@ def gen_key() -> str:
     return secrets.token_hex(16)
 
 
+VALID_KEY_RE = re.compile(r"^[a-f0-9]{32}$")
+
+
+def valid_key(key: str) -> bool:
+    """32 lowercase-hex chars (web/index.php:105-107)."""
+    return isinstance(key, str) and bool(VALID_KEY_RE.match(key.lower()))
+
+
+def valid_email(mail: str) -> bool:
+    """Format check (the reference adds a DNS MX probe, common.php:981-992;
+    that needs egress, so it stays out of the core path)."""
+    return isinstance(mail, str) and bool(
+        re.match(r"^[^@\s]+@[^@\s.]+(\.[^@\s.]+)+$", mail)
+    )
+
+
 class ServerCore:
-    def __init__(self, db: Database, dictdir: str = None, capdir: str = None):
+    def __init__(self, db: Database, dictdir: str = None, capdir: str = None,
+                 mailer=None, bosskey: str = None, captcha=None,
+                 base_url: str = ""):
         self.db = db
         self.dictdir = dictdir
         self.capdir = capdir
+        self.mailer = mailer          # mail.Mailer or None (delivery skipped)
+        self.bosskey = bosskey        # 32-hex superuser key (conf.php)
+        self.captcha = captcha        # callable(response, ip) -> bool, or None
+        self.base_url = base_url      # public URL for mailed links
 
     # ------------------------------------------------------------------
     # Ingestion
@@ -374,6 +398,58 @@ class ServerCore:
             (key, mail),
         )
         return key
+
+    def issue_user_key(self, mail: str, ip: str = "") -> tuple:
+        """The key-issue flow (web/index.php:48-102).
+
+        New mail: insert user (userkey = linkkey = fresh key), send the key
+        by mail, return ("issued", key) — the caller sets the cookie.
+        Known mail: rotate the linkkey at most once per 24h (users.linkkeyts
+        throttle, db/wpa.sql:308-320) and mail a ``?get_key=<linkkey>``
+        confirmation link; return ("reset", key) or ("throttled", None).
+        Mail delivery failures are swallowed like the reference's.
+        """
+        key = gen_key()
+        try:
+            self.db.x(
+                "INSERT INTO users(userkey, linkkey, linkkeyts, mail, ip) "
+                "VALUES (?, ?, ?, ?, ?)",
+                (key, key, now(), mail, ip),
+            )
+        except sqlite3.IntegrityError:
+            updated = self.db.x(
+                "UPDATE users SET linkkey = ?, linkkeyts = ? "
+                "WHERE mail = ? AND (linkkeyts IS NULL OR linkkeyts < ?)",
+                (key, now(), mail, now() - 24 * 3600),
+            ).rowcount
+            if updated != 1:
+                return ("throttled", None)
+            if self.mailer:
+                self.mailer.send(
+                    mail, "dwpa_tpu key change",
+                    "A request for a new user key was submitted. "
+                    "Please follow this link to confirm: "
+                    f"{self.base_url}?get_key={key}",
+                )
+            return ("reset", key)
+        if self.mailer:
+            self.mailer.send(
+                mail, "dwpa_tpu key", f"Key to access results is: {key}"
+            )
+        return ("issued", key)
+
+    def confirm_linkkey(self, linkkey: str) -> bool:
+        """?get_key=<linkkey>: promote linkkey -> userkey
+        (web/content/get_key.php:11-31)."""
+        cur = self.db.x(
+            "UPDATE users SET userkey = linkkey WHERE linkkey = ?", (linkkey,)
+        )
+        return cur.rowcount == 1
+
+    def user_key_exists(self, key: str) -> bool:
+        return (
+            self.db.q1("SELECT 1 FROM users WHERE userkey = ?", (key,)) is not None
+        )
 
     def user_potfile(self, userkey: str) -> list:
         """All of a user's cracked nets as bssid:mac_sta:ssid:pass lines

@@ -107,6 +107,7 @@ CREATE TABLE IF NOT EXISTS users (
     linkkey   TEXT,
     linkkeyts REAL,
     mail      TEXT UNIQUE,
+    ip        TEXT,
     ts        REAL NOT NULL DEFAULT (strftime('%s','now'))
 );
 

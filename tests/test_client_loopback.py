@@ -140,7 +140,7 @@ def test_resume_replay_after_crash(server, tmp_path):
 
     revived.api.get_work = fail_get_work
     revived.cfg.max_work_units = 1
-    # run() skips the challenge here? No — challenge still gates; keep it.
+    # the challenge still gates a resumed session
     assert revived.challenge()
     replayed = revived._read_resume()
     assert replayed == work

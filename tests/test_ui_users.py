@@ -1,0 +1,247 @@
+"""UI pages (visibility tiers) + user-key/mail lifecycle tests.
+
+Reference behavior being matched: web/content/nets.php:17-53 (three
+tiers), search.php:12-117, stats.php, my_nets.php, dicts.php;
+web/index.php:48-142 + get_key.php:11-31 (key issue, 24h throttle,
+linkkey confirmation, cookie set/remove).
+"""
+
+import io
+import urllib.parse
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+from dwpa_tpu.server.mail import CapturingMailer
+from dwpa_tpu.server import ui
+
+PSK = b"tiers-psk-01"
+ESSID = b"TierNet"
+BOSSKEY = "b" * 32
+
+
+@pytest.fixture
+def core(tmp_path):
+    db = Database(":memory:")
+    return ServerCore(db, dictdir=str(tmp_path / "d"), capdir=str(tmp_path / "c"),
+                      mailer=CapturingMailer(), bosskey=BOSSKEY)
+
+
+def _call(app, method="GET", qs="", body=b"", ctype=None, cookie=None):
+    out = {}
+
+    def sr(status, headers):
+        out["status"], out["headers"] = status, headers
+
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": "/",
+        "QUERY_STRING": qs,
+        "CONTENT_LENGTH": str(len(body)),
+        "wsgi.input": io.BytesIO(body),
+        "REMOTE_ADDR": "8.8.4.4",
+        "HTTP_ACCEPT": "text/html",
+    }
+    if ctype:
+        environ["CONTENT_TYPE"] = ctype
+    if cookie:
+        environ["HTTP_COOKIE"] = f"key={cookie}"
+    resp = b"".join(app(environ, sr))
+    return out["status"], dict(out["headers"]), resp
+
+
+def _form(app, qs, fields, cookie=None):
+    body = urllib.parse.urlencode(fields).encode()
+    return _call(app, "POST", qs, body,
+                 ctype="application/x-www-form-urlencoded", cookie=cookie)
+
+
+def _cracked_net(core, userkey=None):
+    line = tfx.make_pmkid_line(PSK, ESSID, seed="ui1")
+    core.add_hashlines([line], userkey=userkey)
+    nhash = core.db.q1("SELECT hash FROM nets")["hash"]
+    core.put_work({"type": "hash", "cand": [{"k": nhash.hex(), "v": PSK.decode()}]})
+    return line
+
+
+# -- visibility tiers ------------------------------------------------------
+
+
+def test_nets_tiers(core):
+    app = make_wsgi_app(core)
+    owner_key = core.create_user("owner@example.com")
+    _cracked_net(core, userkey=owner_key)
+    # second net owned by nobody, also cracked
+    other = tfx.make_pmkid_line(PSK, b"OtherTier", seed="ui2")
+    core.add_hashlines([other])
+    ohash = hl.parse(other)
+    core.put_work({"type": "hash",
+                   "cand": [{"k": core.db.q1(
+                       "SELECT hash FROM nets WHERE ssid = ?", (b"OtherTier",)
+                   )["hash"].hex(), "v": PSK.decode()}]})
+
+    # anonymous: placeholders only
+    _, _, anon = _call(app, qs="nets")
+    assert b"Found" in anon and PSK not in anon
+
+    # bosskey: all passwords
+    _, _, boss = _call(app, qs="nets", cookie=BOSSKEY)
+    assert boss.count(PSK) == 2
+
+    # keyed user: own password in clear, foreign as placeholder
+    _, _, keyed = _call(app, qs="nets", cookie=owner_key)
+    assert keyed.count(PSK) == 1 and b"Found" in keyed
+
+
+def test_uncracked_net_renders_guess_input_and_accepts_claim(core):
+    app = make_wsgi_app(core)
+    line = tfx.make_pmkid_line(PSK, ESSID, seed="ui3")
+    core.add_hashlines([line])
+    nhash = core.db.q1("SELECT hash FROM nets")["hash"]
+    _, _, page = _call(app, qs="nets")
+    assert nhash.hex().encode() in page  # the per-net input field
+
+    # submit a guess through the form -> verified server-side
+    _form(app, "nets", {nhash.hex(): PSK.decode()})
+    assert core.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+
+def test_search_modes(core):
+    app = make_wsgi_app(core)
+    _cracked_net(core)
+    h = hl.parse(tfx.make_pmkid_line(PSK, ESSID, seed="ui1"))
+    mac = h.mac_ap.hex()
+    # full BSSID
+    _, _, page = _call(app, qs="search&search=" + mac)
+    assert ESSID in page
+    # OUI prefix
+    _, _, page = _call(app, qs="search&search=" + mac[:6])
+    assert ESSID in page
+    # client MAC
+    _, _, page = _call(app, qs="search&search=client:" + h.mac_sta.hex())
+    assert ESSID in page
+    # SSID prefix
+    _, _, page = _call(app, qs="search&search=" + urllib.parse.quote("TierN"))
+    assert ESSID in page
+    # too short -> no table
+    _, _, page = _call(app, qs="search&search=ab")
+    assert ESSID not in page
+
+
+def test_stats_my_nets_dicts_pages(core, tmp_path):
+    from dwpa_tpu.server.jobs import maintenance
+
+    app = make_wsgi_app(core)
+    owner_key = core.create_user("o2@example.com")
+    _cracked_net(core, userkey=owner_key)
+    core.add_dict("dict/x.txt.gz", "x.txt.gz", "0" * 32, 42)
+    maintenance(core)
+
+    _, _, stats = _call(app, qs="stats")
+    assert b"Current round ends in" in stats and b"progress" in stats
+    # machine clients still get JSON
+    import json
+    out = {}
+    env = {"REQUEST_METHOD": "GET", "QUERY_STRING": "stats",
+           "wsgi.input": io.BytesIO(b""), "CONTENT_LENGTH": "0"}
+    body = b"".join(app(env, lambda s, h: out.setdefault("s", s)))
+    assert json.loads(body)["cracked"] >= 1
+
+    _, _, mine = _call(app, qs="my_nets", cookie=owner_key)
+    assert PSK in mine and b"Download all founds" in mine
+    _, _, anon = _call(app, qs="my_nets")
+    assert b"No user key set" in anon
+
+    _, _, dicts = _call(app, qs="dicts")
+    assert b"x.txt.gz" in dicts and b"42" in dicts
+
+
+# -- user-key lifecycle ----------------------------------------------------
+
+
+def test_key_issue_flow_new_mail(core):
+    app = make_wsgi_app(core)
+    status, headers, page = _form(app, "get_key", {"mail": "new@example.com"})
+    assert b"User key issued" in page
+    assert "key=" in headers.get("Set-Cookie", "")
+    key = headers["Set-Cookie"].split("key=")[1].split(";")[0]
+    assert core.user_key_exists(key)
+    # the key went out by mail
+    (to, subject, mail_body), = core.mailer.sent
+    assert to == "new@example.com" and key in mail_body
+
+
+def test_key_reset_throttled_24h(core):
+    app = make_wsgi_app(core)
+    _form(app, "get_key", {"mail": "reset@example.com"})
+    first_key = core.mailer.sent[0][2].split(": ")[1]
+
+    # immediate re-request: throttled, no mail
+    _, _, page = _form(app, "get_key", {"mail": "reset@example.com"})
+    assert b"try again tomorrow" in page
+    assert len(core.mailer.sent) == 1
+
+    # age the linkkeyts by >24h -> reset link goes out
+    core.db.x("UPDATE users SET linkkeyts = linkkeyts - 90000")
+    _, _, page = _form(app, "get_key", {"mail": "reset@example.com"})
+    assert b"check your e-mail" in page
+    assert len(core.mailer.sent) == 2
+    link_mail = core.mailer.sent[1][2]
+    assert "?get_key=" in link_mail
+    new_key = link_mail.split("?get_key=")[1].strip()
+
+    # old key still works until the link is followed
+    assert core.user_key_exists(first_key)
+    status, headers, _ = _call(app, qs="get_key=" + new_key)
+    assert status.startswith("302")
+    assert new_key in headers.get("Set-Cookie", "")
+    assert core.user_key_exists(new_key)
+    assert not core.user_key_exists(first_key)
+
+    # a stale/bogus linkkey does not promote
+    _, _, page = _call(app, qs="get_key=" + "c" * 32)
+    assert b"NOT set" in page
+
+
+def test_invalid_mail_rejected(core):
+    app = make_wsgi_app(core)
+    _, _, page = _form(app, "get_key", {"mail": "not-an-email"})
+    assert b"No valid e-mail" in page
+    assert core.mailer.sent == []
+
+
+def test_captcha_seam_gates_issue(core):
+    core.captcha = lambda resp, ip: resp == "ok"
+    app = make_wsgi_app(core)
+    _, _, page = _form(app, "get_key",
+                       {"mail": "c@example.com", "g-recaptcha-response": "bad"})
+    assert b"Captcha validation failed" in page
+    _, _, page = _form(app, "get_key",
+                       {"mail": "c@example.com", "g-recaptcha-response": "ok"})
+    assert b"User key issued" in page
+
+
+def test_cookie_set_and_remove(core):
+    app = make_wsgi_app(core)
+    key = core.create_user("cookie@example.com")
+    status, headers, _ = _form(app, "", {"key": key})
+    assert status.startswith("302") and key in headers["Set-Cookie"]
+    # unknown key -> cookie cleared instead
+    status, headers, _ = _form(app, "", {"key": "d" * 32})
+    assert "Max-Age=0" in headers["Set-Cookie"]
+    # bosskey is always accepted
+    status, headers, _ = _form(app, "", {"key": BOSSKEY})
+    assert BOSSKEY in headers["Set-Cookie"]
+    # explicit removal
+    status, headers, _ = _form(app, "", {"remkey": "1"})
+    assert "Max-Age=0" in headers["Set-Cookie"]
+
+
+def test_viewer_resolution(core):
+    key = core.create_user("v@example.com")
+    assert ui.resolve_viewer(core, BOSSKEY).tier == "boss"
+    assert ui.resolve_viewer(core, key).tier == "keyed"
+    assert ui.resolve_viewer(core, "").tier == "anonymous"
+    assert ui.resolve_viewer(core, "zz").tier == "anonymous"
