@@ -409,14 +409,20 @@ class M22000Engine:
         self._steps[essid] = (len(group), step)
         return step
 
-    def crack_batch(self, passwords) -> list:
-        """One fixed-size batch of candidate byte-strings -> list[Found]."""
+    def _prepare(self, passwords):
+        """Host stage: decode, filter, pad, pack, and start the async H2D.
+
+        Returns ``(pws, nvalid, pw_words)`` or None if nothing valid.  The
+        device_put is asynchronous, so calling this while a previous
+        batch's steps are still executing overlaps the transfer with
+        compute (see ``crack``).
+        """
         # $HEX[...] notation decodes to raw bytes before hashing, matching
         # the server's candidate handling (hc_unhex, web/common.php:3-25).
         pws = [oracle.hc_unhex(p) for p in passwords]
         pws = [p for p in pws if MIN_PSK_LEN <= len(p) <= MAX_PSK_LEN]
         if not pws:
-            return []
+            return None
         nvalid = len(pws)
         # Pad to batch_size (or, for an oversize caller-supplied batch, up
         # to the next mesh-size multiple so the shard_map split stays even).
@@ -426,10 +432,23 @@ class M22000Engine:
         from ..parallel import shard_candidates
 
         pw_words = shard_candidates(self.mesh, bo.pack_passwords_be(pws))
-        founds = []
+        return pws, nvalid, pw_words
+
+    def _dispatch(self, prep):
+        """Launch the crack step for every live ESSID group (no host sync)."""
+        pws, nvalid, pw_words = prep
+        outs = []
         for essid, group in list(self.groups.items()):
             step = self._step_for(essid, group)
-            hits, found_dev, pmk_dev = step(pw_words)
+            outs.append((list(group), step(pw_words)))
+        return pws, nvalid, outs
+
+    def _collect(self, dispatched) -> list:
+        """Sync stage: gate on hits, decode founds, prune cracked nets."""
+        pws, nvalid, outs = dispatched
+        founds = []
+        live = {id(n.line) for g in self.groups.values() for n in g}
+        for group, (hits, found_dev, pmk_dev) in outs:
             # The psum hits-gate: one replicated scalar is the only
             # device->host sync on the (overwhelmingly common) all-miss
             # batch; the [N, V, B] matrix and PMKs stay on device.
@@ -438,7 +457,9 @@ class M22000Engine:
             found = np.array(found_dev)  # [N, V_max, B] (host copy, writable)
             found[:, :, nvalid:] = False
             pmk_host = np.asarray(pmk_dev)
-            for ni, net in enumerate(list(group)):
+            for ni, net in enumerate(group):
+                if id(net.line) not in live:
+                    continue  # cracked by an earlier in-flight batch
                 nf = found[ni]  # [V_max, B]
                 hit_cols = np.flatnonzero(nf.any(axis=0))
                 for b in hit_cols:
@@ -464,17 +485,43 @@ class M22000Engine:
             self.remove(f)
         return founds
 
+    def crack_batch(self, passwords) -> list:
+        """One fixed-size batch of candidate byte-strings -> list[Found]."""
+        prep = self._prepare(passwords)
+        if prep is None:
+            return []
+        return self._collect(self._dispatch(prep))
+
     def crack(self, candidates) -> list:
-        """Stream candidates in engine-sized batches until exhausted."""
+        """Stream candidates in engine-sized batches until exhausted.
+
+        Two-deep software pipeline: while the device crunches batch N, the
+        host decodes/packs batch N+1 and enqueues its (async) H2D copy, so
+        PBKDF2 compute hides the candidate transfer instead of serializing
+        behind it — the double-buffering SURVEY.md §7.3.3 calls for.
+        """
         founds = []
+        in_flight = None
         batch = []
+
+        def submit(b):
+            nonlocal in_flight
+            prep = self._prepare(b)        # async H2D starts here
+            if in_flight is not None:
+                founds.extend(self._collect(in_flight))  # sync on batch N
+                in_flight = None
+            if prep is not None and self.groups:
+                in_flight = self._dispatch(prep)         # launch batch N+1
+
         for pw in candidates:
-            if not self.groups:
+            if not self.groups and in_flight is None:
                 break
             batch.append(pw)
             if len(batch) == self.batch_size:
-                founds += self.crack_batch(batch)
+                submit(batch)
                 batch = []
-        if batch and self.groups:
-            founds += self.crack_batch(batch)
+        if batch:
+            submit(batch)
+        if in_flight is not None:
+            founds.extend(self._collect(in_flight))
         return founds

@@ -36,7 +36,7 @@ from .hmac import hmac_sha1_20, hmac_sha1_blocks, hmac_sha1_precompute
 DEFAULT_TILE = 64
 
 
-def _loop_kernel(iterations, sin_ref, out_ref):
+def _loop_kernel(iterations, unroll, sin_ref, out_ref):
     """One batch tile: run iterations 1..4096 of the PBKDF2 xor-chain.
 
     ``sin_ref``: uint32[15, TILE, 128] — rows 0-4 the HMAC ipad state,
@@ -53,12 +53,13 @@ def _loop_kernel(iterations, sin_ref, out_ref):
         nu = hmac_sha1_20(ist, ost, u)
         return tuple(nu) + tuple(a ^ x for a, x in zip(acc, nu))
 
-    fin = jax.lax.fori_loop(1, iterations, body, u1 + u1, unroll=False)
+    fin = jax.lax.fori_loop(1, iterations, body, u1 + u1, unroll=unroll)
     out_ref[:] = jnp.stack(fin[5:])
 
 
 @functools.partial(
-    jax.jit, static_argnames=("iterations", "tile", "interpret", "prologue_compress")
+    jax.jit,
+    static_argnames=("iterations", "tile", "unroll", "interpret", "prologue_compress"),
 )
 def pbkdf2_sha1_pmk_pallas(
     pw_words,
@@ -67,6 +68,7 @@ def pbkdf2_sha1_pmk_pallas(
     *,
     iterations=4096,
     tile=DEFAULT_TILE,
+    unroll=1,
     interpret=False,
     prologue_compress=None,
 ):
@@ -107,7 +109,7 @@ def pbkdf2_sha1_pmk_pallas(
     sin = sin.reshape(15, padded // 128, 128)
 
     out = pl.pallas_call(
-        functools.partial(_loop_kernel, iterations),
+        functools.partial(_loop_kernel, iterations, unroll),
         grid=(padded // step,),
         in_specs=[
             pl.BlockSpec((15, tile, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM)
