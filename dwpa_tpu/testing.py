@@ -28,10 +28,11 @@ def _rand(seed: str, n: int) -> bytes:
     return out[:n]
 
 
-def make_pmkid_line(psk: bytes, essid: bytes, seed: str = "pmkid") -> str:
+def make_pmkid_line(psk: bytes, essid: bytes, seed: str = "pmkid",
+                    mac_ap: bytes = None, mac_sta: bytes = None) -> str:
     """A PMKID hashline whose PSK is ``psk``."""
-    mac_ap = _rand(seed + "ap", 6)
-    mac_sta = _rand(seed + "sta", 6)
+    mac_ap = mac_ap or _rand(seed + "ap", 6)
+    mac_sta = mac_sta or _rand(seed + "sta", 6)
     pmk = oracle.pmk_from_psk(psk, essid)
     pmkid = oracle.compute_pmkid(pmk, mac_ap, mac_sta)
     return hl.serialize(hl.TYPE_PMKID, pmkid, mac_ap, mac_sta, essid, message_pair=1)
@@ -69,6 +70,8 @@ def make_eapol_line(
     message_pair: int = 0x00,
     seed: str = "eapol",
     key_data: bytes = None,
+    mac_ap: bytes = None,
+    mac_sta: bytes = None,
 ) -> str:
     """An EAPOL hashline whose PSK is ``psk``.
 
@@ -78,8 +81,8 @@ def make_eapol_line(
     match — exercising the reference's NC search semantics
     (web/common.php:234-300).
     """
-    mac_ap = _rand(seed + "ap", 6)
-    mac_sta = _rand(seed + "sta", 6)
+    mac_ap = mac_ap or _rand(seed + "ap", 6)
+    mac_sta = mac_sta or _rand(seed + "sta", 6)
     anonce_rec = _rand(seed + "anonce", 32)
     snonce = _rand(seed + "snonce", 32)
     if key_data is None:
