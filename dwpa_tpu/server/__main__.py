@@ -1,0 +1,162 @@
+"""Server CLI: serve the work API/UI, run cron jobs, and ops tooling.
+
+The reference spreads these across an Apache vhost (web/), crontab
+entries (INSTALL.md:47-52), and hand-run misc/ scripts; here one entry
+point covers them:
+
+    python -m dwpa_tpu.server serve   --db wpa.db --port 8080
+    python -m dwpa_tpu.server jobs    --db wpa.db [--loop]
+    python -m dwpa_tpu.server recrack --db wpa.db
+    python -m dwpa_tpu.server pack-dict --db wpa.db words.txt --name top1k
+    python -m dwpa_tpu.server dedup-dicts a.txt.gz b.txt.gz [--db wpa.db]
+    python -m dwpa_tpu.server fill-pr --db wpa.db
+    python -m dwpa_tpu.server enrich  --db wpa.db
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _core(args):
+    from .core import ServerCore
+    from .db import Database
+
+    return ServerCore(
+        Database(args.db),
+        dictdir=getattr(args, "dictdir", None) or "dicts",
+        capdir=getattr(args, "capdir", None) or "caps",
+        bosskey=getattr(args, "bosskey", None),
+        hcdir=getattr(args, "hcdir", None),
+    )
+
+
+def cmd_serve(args):
+    from wsgiref.simple_server import make_server
+
+    from .api import make_wsgi_app
+
+    app = make_wsgi_app(_core(args))
+    with make_server(args.host, args.port, app) as srv:
+        print(f"dwpa_tpu server on http://{args.host}:{args.port}/", flush=True)
+        srv.serve_forever()
+
+
+def cmd_jobs(args):
+    """The cron layer: one shot of maintenance + keygen by default, or
+    continuous with --loop (maintenance hourly, keygen every 5 min — the
+    INSTALL.md:47-52 cadence)."""
+    from .jobs import keygen_precompute, maintenance
+
+    core = _core(args)
+    if not args.loop:
+        out = {"maintenance": maintenance(core),
+               "keygen": keygen_precompute(core)}
+        print(json.dumps(out, default=str))
+        return
+    last_maint = 0.0
+    while True:
+        now = time.time()
+        if now - last_maint >= args.maint_interval:
+            maintenance(core)
+            last_maint = now
+        keygen_precompute(core)
+        time.sleep(args.keygen_interval)
+
+
+def cmd_recrack(args):
+    from .tools import recrack_verify
+
+    print(json.dumps(recrack_verify(_core(args), limit=args.limit)))
+
+
+def cmd_pack_dict(args):
+    from .tools import pack_dict
+
+    rules = None
+    if args.rules:
+        with open(args.rules) as f:
+            rules = f.read()
+    print(json.dumps(pack_dict(_core(args), args.source, args.name, rules=rules)))
+
+
+def cmd_dedup_dicts(args):
+    from .tools import dedup_dicts
+
+    core = _core(args) if args.db else None
+    print(json.dumps(dedup_dicts(args.paths, core=core)))
+
+
+def cmd_fill_pr(args):
+    from .tools import fill_pr
+
+    print(json.dumps(fill_pr(_core(args), limit=args.limit)))
+
+
+def cmd_enrich(args):
+    from .tools import enrich_message_pair
+
+    print(json.dumps(enrich_message_pair(_core(args), limit=args.limit)))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="dwpa_tpu.server")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp, db_required=True):
+        sp.add_argument("--db", required=db_required, help="sqlite path")
+        sp.add_argument("--dictdir")
+        sp.add_argument("--capdir")
+
+    sp = sub.add_parser("serve", help="run the HTTP API + UI")
+    common(sp)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("--bosskey", help="32-hex superuser key (conf.php)")
+    sp.add_argument("--hcdir", help="client-distribution dir (web/hc/): "
+                                    "dwpa_tpu.version + dwpa_tpu.pyz")
+    sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("jobs", help="run maintenance + keygen precompute")
+    common(sp)
+    sp.add_argument("--loop", action="store_true")
+    sp.add_argument("--maint-interval", type=float, default=3600)
+    sp.add_argument("--keygen-interval", type=float, default=300)
+    sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("recrack", help="re-verify every cracked net")
+    common(sp)
+    sp.add_argument("--limit", type=int)
+    sp.set_defaults(fn=cmd_recrack)
+
+    sp = sub.add_parser("pack-dict", help="package a wordlist for serving")
+    common(sp)
+    sp.add_argument("source", help="input wordlist (.txt or .txt.gz)")
+    sp.add_argument("--name", required=True, help="served dict name")
+    sp.add_argument("--rules", help="hashcat rules file to attach")
+    sp.set_defaults(fn=cmd_pack_dict)
+
+    sp = sub.add_parser("dedup-dicts", help="cross-dict dedup, earlier wins")
+    sp.add_argument("paths", nargs="+")
+    sp.add_argument("--db", help="also refresh dicts rows")
+    sp.add_argument("--dictdir")
+    sp.add_argument("--capdir")
+    sp.set_defaults(fn=cmd_dedup_dicts)
+
+    sp = sub.add_parser("fill-pr", help="backfill probe-request tables")
+    common(sp)
+    sp.add_argument("--limit", type=int)
+    sp.set_defaults(fn=cmd_fill_pr)
+
+    sp = sub.add_parser("enrich", help="backfill message_pair from captures")
+    common(sp)
+    sp.add_argument("--limit", type=int)
+    sp.set_defaults(fn=cmd_enrich)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,21 @@ def _route(core: ServerCore, environ):
                 return "200 OK", "application/octet-stream", f.read()
         return "404 Not Found", "text/plain", b"no such dict"
 
+    if path.startswith("/hc/"):
+        # Client-distribution artifacts (version manifest + archive), the
+        # web/hc/ static dir of the reference (help_crack.py:162,173).
+        name = os.path.basename(path)
+        full = os.path.join(getattr(core, "hcdir", None) or "", name)
+        if getattr(core, "hcdir", None) and os.path.isfile(full):
+            with open(full, "rb") as f:
+                return "200 OK", "application/octet-stream", f.read()
+        return "404 Not Found", "text/plain", b"no such artifact"
+
+    if path not in ("", "/"):
+        # Unknown paths must 404, not render the home page: the client's
+        # update probe treats any 200 body as a version manifest.
+        return "404 Not Found", "text/plain", b"not found"
+
     if "get_work" in qs:
         ver = qs["get_work"][0]
         if not _version_ok(ver):

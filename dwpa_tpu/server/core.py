@@ -63,10 +63,11 @@ def valid_email(mail: str) -> bool:
 class ServerCore:
     def __init__(self, db: Database, dictdir: str = None, capdir: str = None,
                  mailer=None, bosskey: str = None, captcha=None,
-                 base_url: str = ""):
+                 base_url: str = "", hcdir: str = None):
         self.db = db
         self.dictdir = dictdir
         self.capdir = capdir
+        self.hcdir = hcdir            # client-distribution dir (web/hc/)
         self.mailer = mailer          # mail.Mailer or None (delivery skipped)
         self.bosskey = bosskey        # 32-hex superuser key (conf.php)
         self.captcha = captcha        # callable(response, ip) -> bool, or None

@@ -1,0 +1,212 @@
+"""Ops & migration tooling — the reference's ``misc/`` scripts as library
+functions (CLI in server/__main__.py):
+
+- ``recrack_verify``   — re-verify every cracked net from its stored
+  pass/pmk/nc and abort on the first mismatch, the safety net the
+  reference runs after storage migration (misc/migrate_to_m22000.php:
+  121-141, ``die('Recrack failed!')``);
+- ``pack_dict``        — package a wordlist into the served ``.txt.gz``
+  form: deterministic gzip -9, md5 manifest, dicts-table row
+  (misc/create_gz.sh:27-35);
+- ``dedup_dicts``      — cross-dictionary dedup, earlier dicts win,
+  output ordered shortest-word-first (misc/dedup.sh:4-24);
+- ``fill_pr``          — backfill PROBEREQUEST tables by re-parsing
+  archived captures (misc/fill_pr.php:33-71);
+- ``enrich_message_pair`` — upgrade stored hashlines missing
+  message-pair info by re-parsing their original captures
+  (misc/enrich_pmkid.php:44-68).
+
+All functions are idempotent (INSERT OR IGNORE / UNIQUE-keyed writes) so
+re-running a partially-completed pass is safe — matching the reference's
+at-least-once ops posture (SURVEY.md §5.2).
+"""
+
+import gzip
+import hashlib
+import os
+
+from ..models import hashline as hl
+from ..oracle import m22000 as oracle
+from .capture import extract_hashlines
+from .core import SERVER_NC, ServerCore
+from .db import long2mac
+
+
+class RecrackError(RuntimeError):
+    """A stored crack failed re-verification (data corruption or a
+    storage-migration bug); mirrors the reference's hard abort."""
+
+
+def recrack_verify(core: ServerCore, limit: int = None) -> dict:
+    """Re-verify every cracked net; raise RecrackError on any mismatch.
+
+    Nets with a non-empty stored pass are re-cracked from scratch (full
+    PBKDF2 — the migrate_to_m22000.php:121-141 semantics) and the derived
+    PMK compared against the stored one; empty-pass nets (ZeroPMK) are
+    verified by PMK replay.
+    """
+    q = "SELECT * FROM nets WHERE n_state = 1"
+    args = ()
+    if limit:
+        q += " LIMIT ?"
+        args = (limit,)
+    checked = 0
+    for net in core.db.q(q, args):
+        h = hl.parse(net["struct"])
+        if net["pass"]:
+            r = oracle.check_key_m22000(h, [net["pass"]], nc=SERVER_NC)
+        else:
+            r = oracle.check_key_m22000(h, [net["pass"] or b""],
+                                        pmk=net["pmk"], nc=SERVER_NC)
+        if r is None or (net["pmk"] is not None and r[3] != net["pmk"]):
+            raise RecrackError(
+                f"net {net['net_id']} ({long2mac(net['bssid']).hex()}): "
+                f"stored pass/pmk does not re-crack its hashline"
+            )
+        checked += 1
+    return {"checked": checked}
+
+
+def _read_words(path: str):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        return [ln.rstrip(b"\r\n") for ln in f if ln.strip()]
+
+
+def _write_gz(path: str, words) -> bytes:
+    """Deterministic gzip (mtime=0) so the dhash only moves with content."""
+    import io
+
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb", compresslevel=9, mtime=0) as gz:
+        for w in words:
+            gz.write(w + b"\n")
+    blob = buf.getvalue()
+    with open(path, "wb") as f:
+        f.write(blob)
+    return blob
+
+
+def pack_dict(core: ServerCore, source, dname: str, rules: str = None) -> dict:
+    """Package ``source`` (path or iterable of words) as a served dict.
+
+    Writes ``<dictdir>/<dname>`` (deterministic .txt.gz), registers the
+    dicts row with its md5 + wordcount (create_gz.sh emits the same
+    INSERT), returns {dpath, dhash, wcount}.
+    """
+    words = _read_words(source) if isinstance(source, str) else list(source)
+    if not dname.endswith(".txt.gz"):
+        dname += ".txt.gz"
+    os.makedirs(core.dictdir, exist_ok=True)
+    path = os.path.join(core.dictdir, dname)
+    blob = _write_gz(path, words)
+    dhash = hashlib.md5(blob).hexdigest()
+    dpath = f"dict/{dname}"
+    core.add_dict(dpath, dname, dhash, len(words), rules=rules)
+    return {"dpath": dpath, "dhash": dhash, "wcount": len(words)}
+
+
+def dedup_dicts(paths, core: ServerCore = None) -> dict:
+    """Cross-dict dedup: drop words already present in an earlier dict.
+
+    Earlier paths win (the reference pipes successive dicts through
+    ``comm -13``, dedup.sh:4-24); each rewritten dict is ordered
+    shortest-word-first (cheap candidates first, dedup.sh's final sort).
+    When ``core`` is given, matching dicts rows get their dhash/wcount
+    refreshed so clients re-download only what changed.
+    """
+    seen = set()
+    stats = {}
+    for i, path in enumerate(paths):
+        words = _read_words(path)
+        kept = []
+        local = set()
+        for w in words:
+            if w not in seen and w not in local:
+                kept.append(w)
+                local.add(w)
+        kept.sort(key=lambda w: (len(w), w))
+        seen |= local
+        changed = kept != words
+        if changed:
+            # Rewrite only on real content/order change so dhash — and
+            # with it every client's cached copy — stays stable otherwise.
+            if path.endswith(".gz"):
+                _write_gz(path, kept)
+            else:
+                with open(path, "wb") as f:
+                    f.write(b"\n".join(kept) + (b"\n" if kept else b""))
+        stats[path] = {"before": len(words), "after": len(kept)}
+        if core is not None and changed:
+            dname = os.path.basename(path)
+            row = core.db.q1("SELECT d_id FROM dicts WHERE dname = ?", (dname,))
+            if row:
+                with open(path, "rb") as f:
+                    dhash = hashlib.md5(f.read()).hexdigest()
+                core.db.x(
+                    "UPDATE dicts SET dhash = ?, wcount = ? WHERE d_id = ?",
+                    (dhash, len(kept), row["d_id"]),
+                )
+    return stats
+
+
+def _archived_captures(core: ServerCore, limit: int = None):
+    q = "SELECT s_id, localfile FROM submissions WHERE localfile IS NOT NULL"
+    args = ()
+    if limit:
+        q += " LIMIT ?"
+        args = (limit,)
+    for row in core.db.q(q, args):
+        try:
+            with open(row["localfile"], "rb") as f:
+                yield row["s_id"], f.read()
+        except OSError:
+            continue
+
+
+def fill_pr(core: ServerCore, limit: int = None) -> dict:
+    """Re-parse archived captures into the PROBEREQUEST tables.
+
+    The dynamic-dict source (prs/p2s) for captures ingested before the
+    probe-harvest path existed (fill_pr.php:33-71).  INSERT OR IGNORE
+    keyed on (ssid) / (p_id, s_id) makes re-runs free.
+    """
+    subs = probes = 0
+    for s_id, blob in _archived_captures(core, limit):
+        _, prs = extract_hashlines(blob)
+        if prs:
+            core.add_probe_requests(prs, s_id)
+            probes += len(prs)
+        subs += 1
+    return {"submissions": subs, "probes": probes}
+
+
+def enrich_message_pair(core: ServerCore, limit: int = None) -> dict:
+    """Backfill message-pair info on nets whose stored line lacks it.
+
+    Re-parses each archived capture and, for any net matching by m22000
+    identity (the hash over fields 1-7, which *excludes* message_pair —
+    common.php:310-315), replaces a NULL message_pair with the freshly
+    parsed line's value (enrich_pmkid.php:44-68).
+    """
+    updated = 0
+    for s_id, blob in _archived_captures(core, limit):
+        lines, _ = extract_hashlines(blob)
+        for line in lines:
+            try:
+                h = hl.parse(line)
+            except ValueError:
+                continue
+            if h.message_pair is None:
+                continue
+            row = core.db.q1(
+                "SELECT net_id, message_pair FROM nets WHERE hash = ?",
+                (h.key_id(),),
+            )
+            if row and row["message_pair"] is None:
+                core.db.x(
+                    "UPDATE nets SET message_pair = ?, struct = ? WHERE net_id = ?",
+                    (h.message_pair, h.raw, row["net_id"]),
+                )
+                updated += 1
+    return {"updated": updated}

@@ -1,0 +1,176 @@
+"""Ops & migration tooling (server/tools.py + the server CLI) — the
+misc/ script equivalents (migrate recrack, create_gz, dedup, fill_pr,
+enrich_pmkid)."""
+
+import gzip
+import hashlib
+import json
+import os
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.server import tools
+from dwpa_tpu.server.__main__ import main as cli_main
+from dwpa_tpu.server.capture import extract_hashlines
+from dwpa_tpu.server.core import ServerCore
+from dwpa_tpu.server.db import Database
+
+PSK = b"ops-battery-1"
+ESSID = b"OpsNet"
+
+
+@pytest.fixture
+def core(tmp_path):
+    db = Database(":memory:")
+    return ServerCore(db, dictdir=str(tmp_path / "dicts"), capdir=str(tmp_path / "caps"))
+
+
+def _crack_one(core, psk=PSK, essid=ESSID, seed="t1"):
+    line = tfx.make_eapol_line(psk, essid, keyver=2, seed=seed)
+    core.add_hashlines([line])
+    net = core.db.q1("SELECT * FROM nets ORDER BY net_id DESC")
+    assert core.put_work(
+        {"hkey": "0" * 32,
+         "cand": [{"k": net["struct"].split("*")[3], "v": psk.hex()}]}
+    )
+    return core.db.q1("SELECT * FROM nets WHERE net_id = ?", (net["net_id"],))
+
+
+# ---------------------------------------------------------------------------
+# recrack_verify (migrate_to_m22000.php:121-141)
+
+
+def test_recrack_verify_passes_on_good_data(core):
+    row = _crack_one(core)
+    assert row["n_state"] == 1
+    assert tools.recrack_verify(core) == {"checked": 1}
+
+
+def test_recrack_verify_aborts_on_corruption(core):
+    row = _crack_one(core)
+    core.db.x("UPDATE nets SET pass = ? WHERE net_id = ?",
+              (b"wrong-pass-99", row["net_id"]))
+    with pytest.raises(tools.RecrackError):
+        tools.recrack_verify(core)
+
+
+def test_recrack_verify_detects_pmk_mismatch(core):
+    row = _crack_one(core)
+    core.db.x("UPDATE nets SET pmk = ? WHERE net_id = ?",
+              (b"\x13" * 32, row["net_id"]))
+    with pytest.raises(tools.RecrackError):
+        tools.recrack_verify(core)
+
+
+# ---------------------------------------------------------------------------
+# pack_dict (create_gz.sh)
+
+
+def test_pack_dict_deterministic_and_registered(core, tmp_path):
+    words = [b"password", b"letmein99", b"hunter22"]
+    out1 = tools.pack_dict(core, words, "mini")
+    path = os.path.join(core.dictdir, "mini.txt.gz")
+    with gzip.open(path, "rb") as f:
+        assert f.read() == b"".join(w + b"\n" for w in words)
+    with open(path, "rb") as f:
+        assert hashlib.md5(f.read()).hexdigest() == out1["dhash"]
+    row = core.db.q1("SELECT * FROM dicts WHERE dname = 'mini.txt.gz'")
+    assert row["wcount"] == 3 and row["dhash"] == out1["dhash"]
+    # determinism: same content -> same dhash (no mtime in the header)
+    out2 = tools.pack_dict(core, words, "mini")
+    assert out2["dhash"] == out1["dhash"]
+
+
+def test_pack_dict_from_plain_file(core, tmp_path):
+    src = tmp_path / "src.txt"
+    src.write_bytes(b"alpha-key\n\nbeta-key-2\n")
+    out = tools.pack_dict(core, str(src), "fromfile")
+    assert out["wcount"] == 2  # blank line dropped
+
+
+# ---------------------------------------------------------------------------
+# dedup_dicts (dedup.sh)
+
+
+def test_dedup_dicts_earlier_wins_and_sorts(core, tmp_path):
+    a = tmp_path / "a.txt.gz"
+    b = tmp_path / "b.txt.gz"
+    tools._write_gz(str(a), [b"shared-word", b"alpha-only"])
+    tools._write_gz(str(b), [b"zzz-long-word-here", b"shared-word", b"bb-word"])
+    stats = tools.dedup_dicts([str(a), str(b)])
+    assert stats[str(a)] == {"before": 2, "after": 2}
+    assert stats[str(b)] == {"before": 3, "after": 2}
+    with gzip.open(str(b), "rb") as f:
+        kept = f.read().splitlines()
+    # shared word dropped; remainder shortest-first
+    assert kept == [b"bb-word", b"zzz-long-word-here"]
+
+
+def test_dedup_dicts_refreshes_dict_rows(core, tmp_path):
+    out = tools.pack_dict(core, [b"one-word-1", b"two-word-2"], "first")
+    out2 = tools.pack_dict(core, [b"two-word-2", b"three-word"], "second")
+    p1 = os.path.join(core.dictdir, "first.txt.gz")
+    p2 = os.path.join(core.dictdir, "second.txt.gz")
+    tools.dedup_dicts([p1, p2], core=core)
+    row = core.db.q1("SELECT * FROM dicts WHERE dname = 'second.txt.gz'")
+    assert row["wcount"] == 1
+    assert row["dhash"] != out2["dhash"]
+
+
+# ---------------------------------------------------------------------------
+# fill_pr / enrich_message_pair (fill_pr.php / enrich_pmkid.php)
+
+
+def test_fill_pr_backfills_probes(core):
+    blob, _ = tfx.make_handshake_capture(
+        PSK, ESSID, seed="pr1", probes=(b"CoffeeShop", b"airport-free")
+    )
+    s_id = core.add_submission(blob)
+    # legacy-style ingest: hashlines only, probes never harvested
+    lines, _probes = extract_hashlines(blob)
+    core.add_hashlines(lines, s_id=s_id)
+    assert core.db.q1("SELECT COUNT(*) c FROM prs")["c"] == 0
+    out = tools.fill_pr(core)
+    assert out["submissions"] == 1 and out["probes"] == 2
+    assert core.db.q1("SELECT COUNT(*) c FROM prs")["c"] == 2
+    # idempotent
+    assert tools.fill_pr(core)["submissions"] == 1
+    assert core.db.q1("SELECT COUNT(*) c FROM prs")["c"] == 2
+
+
+def test_enrich_message_pair_backfills_nulls(core):
+    blob, _ = tfx.make_handshake_capture(PSK, ESSID, seed="en1", with_pmkid=False)
+    s_id = core.add_submission(blob)
+    lines, _ = extract_hashlines(blob)
+    core.add_hashlines(lines, s_id=s_id)
+    # simulate a legacy row migrated without message-pair info
+    core.db.x("UPDATE nets SET message_pair = NULL")
+    out = tools.enrich_message_pair(core)
+    assert out["updated"] == 1
+    row = core.db.q1("SELECT message_pair, struct FROM nets")
+    assert row["message_pair"] is not None
+    assert row["struct"] == lines[0]
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing
+
+
+def test_cli_pack_and_recrack(tmp_path, capsys):
+    db = str(tmp_path / "wpa.db")
+    src = tmp_path / "w.txt"
+    src.write_bytes(b"cli-word-01\ncli-word-02\n")
+    cli_main(["pack-dict", "--db", db, str(src), "--name", "cli",
+              "--dictdir", str(tmp_path / "d")])
+    out = json.loads(capsys.readouterr().out)
+    assert out["wcount"] == 2
+    cli_main(["recrack", "--db", db])
+    assert json.loads(capsys.readouterr().out) == {"checked": 0}
+
+
+def test_cli_jobs_once(tmp_path, capsys):
+    db = str(tmp_path / "wpa.db")
+    cli_main(["jobs", "--db", db])
+    out = json.loads(capsys.readouterr().out)
+    assert "maintenance" in out and "keygen" in out
