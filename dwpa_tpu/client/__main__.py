@@ -22,6 +22,9 @@ def build_parser():
     p.add_argument("-d", "--dictcount", type=int, default=1, help="initial dict count 1..15")
     p.add_argument("-b", "--batch-size", type=int, default=16384, help="device batch size")
     p.add_argument("-n", "--max-work-units", type=int, default=0, help="stop after N units")
+    p.add_argument("--nc", type=int, default=8,
+                   help="nonce-error-correction budget (reference -co "
+                        "--nonce-error-corrections, help_crack.py:773)")
     return p
 
 
@@ -35,6 +38,7 @@ def main(argv=None):
         additional_dict=args.additional_dict,
         potfile=args.potfile,
         max_work_units=args.max_work_units,
+        nc=args.nc,
     )
     TpuCrackClient(cfg).run()
 

@@ -22,6 +22,7 @@ Equivalent of the reference client's fetch->crack->submit loop
 import base64
 import json
 import os
+import re
 import time
 from dataclasses import dataclass, field
 
@@ -29,12 +30,24 @@ from ..gen import DictStream, psk_candidates
 from ..models import hashline as hl
 from ..models.m22000 import M22000Engine
 from ..rules import apply_rules, parse_rules
+from .. import __version__
 from .. import testing as synth
 from ..oracle import m22000 as oracle
 from .protocol import NoNets, ServerAPI
+from .targeted import targeted_candidates
 
 PACE_TARGET_S = 900.0  # work-unit pacing target (reference autotune threshold)
 CHALLENGE_PSK = b"aaaa1234"
+
+
+def version_tuple(v: str):
+    """Order dotted versions with optional alpha suffixes, matching the
+    reference's numeric+alpha compare (help_crack.py:128-156)."""
+    parts = []
+    for piece in v.strip().split("."):
+        m = re.match(r"(\d*)(.*)", piece)
+        parts.append((int(m.group(1) or 0), m.group(2)))
+    return tuple(parts)
 
 
 @dataclass
@@ -70,6 +83,38 @@ class TpuCrackClient:
         self.resume_path = os.path.join(config.workdir, "resume.json")
         self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
         self.dictcount = max(1, min(15, config.dictcount))
+
+    # -- self-update (help_crack.py:158-189) --------------------------------
+
+    def check_update(self) -> bool:
+        """Probe the server-published client version; download on newer.
+
+        The reference overwrites sys.argv[0] and exits; a package can't
+        safely self-overwrite mid-import, so the new archive lands in the
+        workdir and run() exits for the supervisor to swap it in —
+        operationally the same restart-to-update contract.
+        """
+        manifest = self.api.remote_version().split()
+        # Manifest: "<version> [archive-md5]".  It must look like a
+        # version — a misconfigured server returning an HTML page for the
+        # probe must not trigger updates.
+        remote = manifest[0] if manifest else ""
+        md5 = manifest[1] if len(manifest) > 1 else None
+        if not remote or not re.fullmatch(r"[0-9]+(\.[0-9]+)*[a-z0-9]*", remote):
+            return False
+        if version_tuple(remote) <= version_tuple(__version__):
+            return False
+        dest = os.path.join(self.cfg.workdir, f"dwpa_tpu-{remote}.pyz")
+        try:
+            # Bounded tries: a manifest pointing at a missing archive must
+            # not park the crack loop in the infinite-retry backoff.
+            self.api.download("hc/dwpa_tpu.pyz", dest, expected_md5=md5,
+                              max_tries=2)
+        except (ConnectionError, ValueError, OSError) as e:
+            self.log(f"update {remote} advertised but download failed: {e}")
+            return False
+        self.log(f"update {__version__} -> {remote} downloaded to {dest}; restart to apply")
+        return True
 
     # -- challenge gate ----------------------------------------------------
 
@@ -130,7 +175,12 @@ class TpuCrackClient:
         return parse_rules(text.splitlines())
 
     def _targeted_candidates(self, engine: M22000Engine, work: dict):
-        """Pass-1 generator: hash-material candidates + dynamic PR dict."""
+        """Pass-1 generator, in the DAW client's priority order
+        (help_crack.py:615-687): ESSID-fingerprint family keyspaces
+        first, then hash-material candidates, the dynamic PR dict, and
+        any local additional dictionary."""
+        essids = list(engine.groups)
+        yield from targeted_candidates(essids)
         for net in engine.nets:
             yield from psk_candidates(
                 net.line.essid, net.line.mac_ap, net.line.mac_sta
@@ -184,6 +234,14 @@ class TpuCrackClient:
             run_pass(apply_rules(rules, stream) if rules else stream)
 
         elapsed = time.time() - t0
+        st = engine.stage_times
+        crack_s = sum(st.values())
+        self.log(
+            "stages: pack+h2d=%.1fs dispatch=%.1fs device+sync=%.1fs "
+            "other=%.1fs (tried %d)"
+            % (st["prepare"], st["dispatch"], st["collect"],
+               max(0.0, elapsed - crack_s), tried)
+        )
         result = WorkResult(
             hkey=work["hkey"], founds=founds, elapsed=elapsed,
             candidates_tried=tried,
@@ -205,7 +263,9 @@ class TpuCrackClient:
             self.dictcount -= 1
 
     def run(self) -> int:
-        """Challenge-gate then loop work units; returns units processed."""
+        """Update-check + challenge-gate, then loop work units."""
+        if self.check_update():
+            raise SystemExit("client update downloaded; restart to apply")
         if not self.challenge():
             raise SystemExit("challenge failed: cracker output untrusted")
         done = 0

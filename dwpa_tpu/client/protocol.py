@@ -52,8 +52,14 @@ class ServerAPI:
 
     # -- low level ---------------------------------------------------------
 
-    def fetch(self, url: str, data: dict = None) -> bytes:
-        """GET (or POST json) with retry/backoff."""
+    def fetch(self, url: str, data: dict = None, max_tries: int = None) -> bytes:
+        """GET (or POST json) with retry/backoff.
+
+        ``max_tries`` overrides the instance default for callers that
+        must fail fast (e.g. the optional self-update artifacts, which
+        must never park the crack loop in the infinite-retry backoff).
+        """
+        limit = self.max_tries if max_tries is None else max_tries
         tries = 0
         body = None
         headers = {}
@@ -67,7 +73,7 @@ class ServerAPI:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     return r.read()
             except (urllib.error.URLError, OSError, TimeoutError) as e:
-                if self.max_tries and tries >= self.max_tries:
+                if limit and tries >= limit:
                     raise ConnectionError(f"giving up on {url}: {e}") from e
                 self.sleep(self.backoff)
 
@@ -106,10 +112,28 @@ class ServerAPI:
             raw = gzip.decompress(raw)
         return [w for w in raw.split(b"\n") if w]
 
-    def download(self, url: str, dest: str, expected_md5: str = None) -> str:
+    def remote_version(self) -> str:
+        """The server-published client version (self-update probe).
+
+        Reference: GET ``hc/help_crack.py.version`` (help_crack.py:162);
+        here the artifact is the package archive, so the manifest is
+        ``hc/dwpa_tpu.version``.  Returns '' when the server doesn't
+        publish one (non-updating deployments) — a single non-retrying
+        probe, unlike ``fetch`` (a missing manifest must not spin the
+        infinite-retry loop).
+        """
+        url = urllib.parse.urljoin(self.base_url, "hc/dwpa_tpu.version")
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                return r.read().decode("utf-8", "replace").strip()
+        except (urllib.error.URLError, OSError, TimeoutError):
+            return ""
+
+    def download(self, url: str, dest: str, expected_md5: str = None,
+                 max_tries: int = None) -> str:
         if not urllib.parse.urlparse(url).scheme:
             url = urllib.parse.urljoin(self.base_url, url)
-        data = self.fetch(url)
+        data = self.fetch(url, max_tries=max_tries)
         if expected_md5 is not None:
             got = hashlib.md5(data).hexdigest()
             if got != expected_md5:

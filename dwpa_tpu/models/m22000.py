@@ -28,6 +28,7 @@ TPU-first design:
 """
 
 import struct
+import time
 from dataclasses import dataclass
 
 import jax
@@ -371,6 +372,10 @@ class M22000Engine:
         self.groups = {}  # essid -> list[PreppedNet]
         self.skipped = []
         self._steps = {}  # essid -> (n_nets, jitted crack step)
+        # Per-stage wall-clock accumulators (SURVEY.md §5.1): host pack +
+        # H2D enqueue / device dispatch / sync + decode.  "collect" is
+        # where device compute surfaces under the async runtime.
+        self.stage_times = {"prepare": 0.0, "dispatch": 0.0, "collect": 0.0}
         for line in lines:
             try:
                 h = line if isinstance(line, hl.Hashline) else hl.parse(line)
@@ -417,6 +422,7 @@ class M22000Engine:
         batch's steps are still executing overlaps the transfer with
         compute (see ``crack``).
         """
+        t0 = time.perf_counter()
         # $HEX[...] notation decodes to raw bytes before hashing, matching
         # the server's candidate handling (hc_unhex, web/common.php:3-25).
         pws = [oracle.hc_unhex(p) for p in passwords]
@@ -432,19 +438,23 @@ class M22000Engine:
         from ..parallel import shard_candidates
 
         pw_words = shard_candidates(self.mesh, bo.pack_passwords_be(pws))
+        self.stage_times["prepare"] += time.perf_counter() - t0
         return pws, nvalid, pw_words
 
     def _dispatch(self, prep):
         """Launch the crack step for every live ESSID group (no host sync)."""
+        t0 = time.perf_counter()
         pws, nvalid, pw_words = prep
         outs = []
         for essid, group in list(self.groups.items()):
             step = self._step_for(essid, group)
             outs.append((list(group), step(pw_words)))
+        self.stage_times["dispatch"] += time.perf_counter() - t0
         return pws, nvalid, outs
 
     def _collect(self, dispatched) -> list:
         """Sync stage: gate on hits, decode founds, prune cracked nets."""
+        t0 = time.perf_counter()
         pws, nvalid, outs = dispatched
         founds = []
         for group, (hits, found_dev, pmk_dev) in outs:
@@ -480,6 +490,7 @@ class M22000Engine:
                     break  # one PSK per net is enough
         for f in founds:
             self.remove(f)
+        self.stage_times["collect"] += time.perf_counter() - t0
         return founds
 
     def crack_batch(self, passwords) -> list:

@@ -36,7 +36,7 @@ class LoopbackAPI(ServerAPI):
         self.app = app
         self.requests = []
 
-    def fetch(self, url: str, data: dict = None) -> bytes:
+    def fetch(self, url: str, data: dict = None, max_tries: int = None) -> bytes:
         parsed = urllib.parse.urlparse(url)
         body = json.dumps(data).encode() if data is not None else b""
         environ = {

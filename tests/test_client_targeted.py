@@ -1,0 +1,146 @@
+"""Targeted-attack table, self-update, and per-stage timing — the DAW
+client parity features (help_crack.py:615-687, :158-189; SURVEY.md §5.1)."""
+
+import itertools
+import os
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.client import targeted as tg
+from dwpa_tpu.client.main import ClientConfig, TpuCrackClient, version_tuple
+
+from test_client_loopback import LoopbackAPI, _add_dict, _client, _ingest, server  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# targeted table
+
+
+def test_netgear_family_shape():
+    family, gen = tg.targeted_for_essid(b"NETGEAR57")
+    assert family == "netgear"
+    first = list(itertools.islice(gen, 3))
+    assert first == [b"ancientapple000", b"ancientapple001", b"ancientapple002"]
+
+
+def test_phome_family_prefix():
+    family, gen = tg.targeted_for_essid(b"PLDTHOMEDSL")
+    assert family == "phome"
+    assert next(iter(gen)) == b"PLDTWIFI00000"
+
+
+def test_imei_family_shape():
+    family, gen = tg.targeted_for_essid(b"AndroidAP_9981")
+    assert family == "imei"
+    cand = next(iter(gen))
+    assert len(cand) == 8 and cand.isdigit()
+
+
+def test_no_match_returns_none():
+    assert tg.targeted_for_essid(b"MyHomeWifi") == (None, None)
+
+
+def test_budget_bounds_generator():
+    _, gen = tg.targeted_for_essid(b"Tenda_ABC123", budget=10)
+    assert len(list(gen)) == 10
+
+
+def test_family_dedup_across_essids():
+    cands = list(tg.targeted_candidates([b"NETGEAR11", b"NETGEAR22"], budget=5))
+    assert len(cands) == 5  # one netgear pass, not two
+
+
+def test_shared_keyspace_dedup_across_families():
+    # netgear and spectrum share the word-word-digits keyspace; a work
+    # unit holding both must stream it once, not twice
+    cands = list(
+        tg.targeted_candidates([b"NETGEAR11", b"MySpectrumWiFi88"], budget=7)
+    )
+    assert len(cands) == 7
+
+
+def test_update_manifest_with_archive_md5(tmp_path):
+    api = _FakeUpdateAPI("9.9.9 0123456789abcdef0123456789abcdef")
+    c = _update_client(tmp_path, api)
+    assert c.check_update() is True
+    assert api.downloads[0][2] == "0123456789abcdef0123456789abcdef"
+
+
+def test_update_rejects_html_manifest(tmp_path):
+    api = _FakeUpdateAPI("<html>dwpa server</html>")
+    assert _update_client(tmp_path, api).check_update() is False
+
+
+# ---------------------------------------------------------------------------
+# self-update
+
+
+def test_version_tuple_ordering():
+    assert version_tuple("2.3.1") > version_tuple("2.3")
+    assert version_tuple("0.2.0") > version_tuple("0.1.9")
+    assert version_tuple("1.0.0a") > version_tuple("1.0.0")
+    assert version_tuple("0.1.0") == version_tuple("0.1.0")
+
+
+class _FakeUpdateAPI:
+    def __init__(self, remote, fail_download=False):
+        self._remote = remote
+        self.fail_download = fail_download
+        self.downloads = []
+
+    def remote_version(self):
+        return self._remote
+
+    def download(self, url, dest, expected_md5=None, max_tries=None):
+        if self.fail_download:
+            raise ConnectionError("nope")
+        assert max_tries, "update downloads must bound their retries"
+        self.downloads.append((url, dest, expected_md5))
+        with open(dest, "wb") as f:
+            f.write(b"new-archive")
+        return dest
+
+
+def _update_client(tmp_path, api):
+    cfg = ClientConfig(base_url="http://x/", workdir=str(tmp_path / "w"))
+    return TpuCrackClient(cfg, api=api, log=lambda *a: None)
+
+
+def test_check_update_downloads_newer(tmp_path):
+    api = _FakeUpdateAPI("9.9.9")
+    c = _update_client(tmp_path, api)
+    assert c.check_update() is True
+    assert api.downloads[0][0] == "hc/dwpa_tpu.pyz"
+    assert os.path.exists(api.downloads[0][1])
+
+
+def test_check_update_skips_same_or_absent(tmp_path):
+    assert _update_client(tmp_path, _FakeUpdateAPI("")).check_update() is False
+    assert _update_client(tmp_path, _FakeUpdateAPI("0.0.1")).check_update() is False
+
+
+def test_check_update_survives_download_failure(tmp_path):
+    c = _update_client(tmp_path, _FakeUpdateAPI("9.9.9", fail_download=True))
+    assert c.check_update() is False  # keep cracking on a flaky mirror
+
+
+# ---------------------------------------------------------------------------
+# loopback: targeted family cracks a net with no dictionary word
+
+
+def test_targeted_pass_cracks_isp_default(server, tmp_path):
+    # PLDTWIFI00007 is candidate #8 of the phome family keyspace — pass 1
+    # must crack it even though the served dict has no useful words.
+    psk = b"PLDTWIFI00007"
+    _ingest(server, [tfx.make_pmkid_line(psk, b"PLDTHOMEDSL", seed="tp1")])
+    _add_dict(server, [b"useless-word-1"])
+    client = _client(server, tmp_path, batch_size=64)
+    stages = []
+    client.log = lambda msg: stages.append(msg)
+    work = client.api.get_work(client.dictcount)
+    res = client.process_work(work)
+    assert [f.psk for f in res.founds] == [psk]
+    assert res.accepted
+    # per-stage timing surfaced (SURVEY.md §5.1)
+    assert any(m.startswith("stages: pack+h2d=") for m in stages)
