@@ -80,7 +80,7 @@ def _pcapng_frames(data: bytes):
         body = data[off + 8 : off + blen - 4]
         if btype == 0x00000001:  # IDB
             ifaces.append(struct.unpack_from(endian + "H", body, 0)[0])
-        elif btype == 0x00000006 and body[:4] != b"":  # EPB
+        elif btype == 0x00000006 and len(body) >= 20:  # EPB
             iface, _, _, caplen, _ = struct.unpack_from(endian + "IIIII", body, 0)
             frame = body[20 : 20 + caplen]
             lt = ifaces[iface] if iface < len(ifaces) else DLT_IEEE802_11

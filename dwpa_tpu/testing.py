@@ -159,21 +159,63 @@ def probe_request_frame(sta: bytes, essid: bytes) -> bytes:
     return _dot11_mgmt(4, b"\xff" * 6, sta, b"\xff" * 6, body)
 
 
-def pcap_bytes(frames, linktype: int = 105) -> bytes:
-    """Wrap raw 802.11 frames in a classic little-endian pcap container."""
-    out = struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, linktype)
+def pcap_bytes(frames, linktype: int = 105, endian: str = "<",
+               nsec: bool = False) -> bytes:
+    """Wrap raw 802.11 frames in a classic pcap container.
+
+    ``endian``: '<' (the common case) or '>' (big-endian writer);
+    ``nsec``: use the nanosecond-resolution magic.  Exercises every
+    container variant server/capture.py accepts.
+    """
+    magic = 0xA1B23C4D if nsec else 0xA1B2C3D4
+    out = struct.pack(endian + "IHHiIII", magic, 2, 4, 0, 0, 65535, linktype)
     for i, fr in enumerate(frames):
-        out += struct.pack("<IIII", 1700000000 + i, 0, len(fr), len(fr)) + fr
+        out += struct.pack(endian + "IIII", 1700000000 + i, 0, len(fr), len(fr)) + fr
     return out
 
 
-def make_handshake_capture(psk: bytes, essid: bytes, seed: str = "cap",
-                           with_pmkid: bool = True, probes=()) -> tuple:
-    """A synthetic pcap holding beacon + M1 + M2 for a known PSK.
+def pcapng_bytes(frames, linktype: int = 105, endian: str = "<",
+                 simple: bool = False) -> bytes:
+    """Wrap frames in a pcapng container (SHB + IDB + EPB/SPB blocks)."""
+    def block(btype: int, body: bytes) -> bytes:
+        pad = (-len(body)) % 4
+        total = 12 + len(body) + pad
+        return (struct.pack(endian + "II", btype, total) + body + b"\x00" * pad
+                + struct.pack(endian + "I", total))
 
-    Returns (pcap_blob, expected_hashline_count).  The M2 MIC is real
-    (derived from the PSK via the oracle) so end-to-end ingest->crack
-    tests can recover ``psk``.
+    bom = struct.pack(endian + "I", 0x1A2B3C4D)
+    shb = block(0x0A0D0D0A, bom + struct.pack(endian + "HHq", 1, 0, -1))
+    idb = block(0x00000001, struct.pack(endian + "HHI", linktype, 0, 65535))
+    out = shb + idb
+    for fr in frames:
+        if simple:
+            out += block(0x00000003, struct.pack(endian + "I", len(fr)) + fr)
+        else:
+            body = struct.pack(endian + "IIIII", 0, 0, 0, len(fr), len(fr)) + fr
+            out += block(0x00000006, body)
+    return out
+
+
+def radiotap_wrap(frames, rt_len: int = 8):
+    """Prepend a minimal radiotap header (DLT 127) to each frame."""
+    hdr = struct.pack("<BBHI", 0, 0, rt_len, 0).ljust(rt_len, b"\x00")
+    return [hdr + fr for fr in frames]
+
+
+def ppi_wrap(frames, ppi_len: int = 8):
+    """Prepend a minimal PPI header (DLT 192) to each frame."""
+    hdr = struct.pack("<BBHI", 0, 0, ppi_len, 105).ljust(ppi_len, b"\x00")
+    return [hdr + fr for fr in frames]
+
+
+def make_handshake_frames(psk: bytes, essid: bytes, seed: str = "cap",
+                          with_pmkid: bool = True, probes=()) -> tuple:
+    """Raw 802.11 frames (beacon + probes + M1 + M2) for a known PSK.
+
+    Returns (frames, expected_hashline_count); wrap with ``pcap_bytes`` /
+    ``pcapng_bytes`` / ``radiotap_wrap`` to exercise a container path.
+    The M2 MIC is real (derived from the PSK via the oracle) so
+    end-to-end ingest->crack tests can recover ``psk``.
     """
     mac_ap = _rand(seed + "ap", 6)
     mac_sta = _rand(seed + "sta", 6)
@@ -201,4 +243,13 @@ def make_handshake_capture(psk: bytes, essid: bytes, seed: str = "cap",
         _dot11_data_eapol(mac_ap, mac_sta, mac_ap, m1, from_ds=True),
         _dot11_data_eapol(mac_sta, mac_ap, mac_ap, m2, from_ds=False),
     ]
+    return frames, expected
+
+
+def make_handshake_capture(psk: bytes, essid: bytes, seed: str = "cap",
+                           with_pmkid: bool = True, probes=()) -> tuple:
+    """``make_handshake_frames`` in a classic LE pcap container."""
+    frames, expected = make_handshake_frames(
+        psk, essid, seed=seed, with_pmkid=with_pmkid, probes=probes
+    )
     return pcap_bytes(frames), expected

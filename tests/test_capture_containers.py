@@ -1,0 +1,142 @@
+"""Capture-container and EAPOL-pairing coverage for server/capture.py —
+the pcapng / radiotap / PPI / big-endian / M2+M3 / M3+M4 paths round 1
+left untested (hcxpcapngtool parity surfaces)."""
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.oracle import m22000 as oracle
+from dwpa_tpu.server.capture import extract_hashlines, iter_frames
+
+PSK = b"container-psk"
+ESSID = b"ContainerNet"
+
+
+def _lines_crack(blob, expected, psk=PSK):
+    lines, _ = extract_hashlines(blob)
+    assert len(lines) == expected
+    for line in lines:
+        assert oracle.check_key_m22000(hl.parse(line), [psk]) is not None
+    return lines
+
+
+FRAMES, EXPECTED = tfx.make_handshake_frames(PSK, ESSID, seed="cc")
+
+
+# ---------------------------------------------------------------------------
+# classic pcap variants
+
+
+def test_bigendian_pcap():
+    _lines_crack(tfx.pcap_bytes(FRAMES, endian=">"), EXPECTED)
+
+
+def test_nanosecond_magic_pcap():
+    _lines_crack(tfx.pcap_bytes(FRAMES, nsec=True), EXPECTED)
+    _lines_crack(tfx.pcap_bytes(FRAMES, endian=">", nsec=True), EXPECTED)
+
+
+# ---------------------------------------------------------------------------
+# pcapng
+
+
+def test_pcapng_epb_little_endian():
+    _lines_crack(tfx.pcapng_bytes(FRAMES), EXPECTED)
+
+
+def test_pcapng_epb_big_endian():
+    _lines_crack(tfx.pcapng_bytes(FRAMES, endian=">"), EXPECTED)
+
+
+def test_pcapng_simple_packet_blocks():
+    _lines_crack(tfx.pcapng_bytes(FRAMES, simple=True), EXPECTED)
+
+
+def test_pcapng_probes_survive():
+    frames, _ = tfx.make_handshake_frames(
+        PSK, ESSID, seed="ccpr", probes=(b"CafeWifi",)
+    )
+    _, probes = extract_hashlines(tfx.pcapng_bytes(frames))
+    assert probes == [b"CafeWifi"]
+
+
+# ---------------------------------------------------------------------------
+# link-layer wrappers
+
+
+def test_radiotap_frames():
+    _lines_crack(tfx.pcap_bytes(tfx.radiotap_wrap(FRAMES), linktype=127), EXPECTED)
+
+
+def test_radiotap_long_header():
+    _lines_crack(
+        tfx.pcap_bytes(tfx.radiotap_wrap(FRAMES, rt_len=24), linktype=127), EXPECTED
+    )
+
+
+def test_ppi_frames():
+    _lines_crack(tfx.pcap_bytes(tfx.ppi_wrap(FRAMES), linktype=192), EXPECTED)
+
+
+def test_unknown_linktype_skipped():
+    lines, probes = extract_hashlines(tfx.pcap_bytes(FRAMES, linktype=1))
+    assert lines == [] and probes == []
+
+
+def test_truncated_container_tolerated():
+    blob = tfx.pcap_bytes(FRAMES)
+    lines, _ = extract_hashlines(blob[: len(blob) // 2])
+    assert isinstance(lines, list)  # no crash on truncation
+
+
+# ---------------------------------------------------------------------------
+# M2+M3 and M3+M4 pairings (message_pair 2 / 3, common.php:114-155)
+
+
+def _paired_capture(seed, sta_msgs, ap_replay, sta_replay, m4_snonce=True):
+    """Build a capture holding an M3 plus the given STA message."""
+    mac_ap = tfx._rand(seed + "ap", 6)
+    mac_sta = tfx._rand(seed + "sta", 6)
+    anonce = tfx._rand(seed + "anonce", 32)
+    snonce = tfx._rand(seed + "snonce", 32)
+    pmk = oracle.pmk_from_psk(PSK, ESSID)
+
+    # the STA frame whose MIC lands in the hashline
+    ki_sta = 0x010A if sta_msgs == 2 else 0x030A
+    sn = snonce if (sta_msgs == 2 or m4_snonce) else b"\x00" * 32
+    zero = tfx.build_eapol_key_frame(ki_sta, sta_replay, sn,
+                                     key_data=tfx._rand(seed + "kd", 22))
+    m = min(mac_ap, mac_sta) + max(mac_ap, mac_sta)
+    n = snonce + anonce if snonce[:6] < anonce[:6] else anonce + snonce
+    mic = oracle.compute_mic(pmk, 2, m, n, zero)
+    sta_frame = zero[:81] + mic + zero[97:]
+
+    m3 = tfx.build_eapol_key_frame(0x13CA, ap_replay, anonce)
+    frames = [
+        tfx.beacon_frame(mac_ap, ESSID),
+        tfx._dot11_data_eapol(mac_ap, mac_sta, mac_ap, m3, from_ds=True),
+        tfx._dot11_data_eapol(mac_sta, mac_ap, mac_ap, sta_frame, from_ds=False),
+    ]
+    return tfx.pcap_bytes(frames)
+
+
+def test_m2_m3_pairing():
+    # M3 replay = M2 replay + 1 (the authenticated-ANONCE pairing)
+    blob = _paired_capture("p23", sta_msgs=2, ap_replay=2, sta_replay=1)
+    lines = _lines_crack(blob, 1)
+    assert hl.parse(lines[0]).message_pair & 0x07 == 0x02
+
+
+def test_m3_m4_pairing():
+    blob = _paired_capture("p34", sta_msgs=4, ap_replay=2, sta_replay=2)
+    lines = _lines_crack(blob, 1)
+    assert hl.parse(lines[0]).message_pair & 0x07 == 0x03
+
+
+def test_m4_zero_snonce_not_paired():
+    # an M4 with an all-zero SNONCE cannot derive the PTK; no hashline
+    blob = _paired_capture("p34z", sta_msgs=4, ap_replay=2, sta_replay=2,
+                           m4_snonce=False)
+    lines, _ = extract_hashlines(blob)
+    assert lines == []
