@@ -20,6 +20,7 @@ Equivalent of the reference client's fetch->crack->submit loop
 """
 
 import base64
+import itertools
 import json
 import os
 import re
@@ -134,8 +135,14 @@ class TpuCrackClient:
     # -- work-unit plumbing ------------------------------------------------
 
     def _write_resume(self, work: dict):
-        with open(self.resume_path, "w") as f:
+        # Atomic replace: the checkpoint is rewritten mid-unit after every
+        # batch, and a crash during the write must never corrupt the only
+        # copy (a truncated snapshot would be discarded on restart and the
+        # whole work unit lost until the server's lease reap).
+        tmp = self.resume_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(work, f)
+        os.replace(tmp, self.resume_path)
 
     def _clear_resume(self):
         if os.path.exists(self.resume_path):
@@ -186,11 +193,20 @@ class TpuCrackClient:
                 net.line.essid, net.line.mac_ap, net.line.mac_sta
             )
         if work.get("prdict"):
-            try:
-                for w in self.api.get_prdict(work["hkey"]):
-                    yield oracle.hc_unhex(w)
-            except (ConnectionError, ValueError):
-                pass
+            # Snapshot the dynamic PR dict into the work/resume state: the
+            # server-side query is unordered and grows with new
+            # submissions, so re-fetching after a crash would misalign the
+            # resume's skip-by-count fast-forward.  The snapshot rides
+            # every checkpoint write, making the stream deterministic.
+            if "_prdict_cache" not in work:
+                try:
+                    words = self.api.get_prdict(work["hkey"])
+                except (ConnectionError, ValueError):
+                    words = []
+                work["_prdict_cache"] = [w.hex() for w in words]
+                self._write_resume(work)
+            for wx in work["_prdict_cache"]:
+                yield oracle.hc_unhex(bytes.fromhex(wx))
         if self.cfg.additional_dict:
             yield from DictStream(self.cfg.additional_dict)
 
@@ -201,37 +217,54 @@ class TpuCrackClient:
 
     # -- the loop ----------------------------------------------------------
 
+    def _all_candidates(self, engine: M22000Engine, work: dict):
+        """The full deterministic candidate stream for one work unit:
+        pass 1 (targeted, no rules) then pass 2 (server dicts through
+        server rules).  Dict downloads happen lazily when the stream
+        reaches them, so a resume skipping pass 1 still fetches dicts."""
+        yield from self._targeted_candidates(engine, work)
+        rules = self._rules(work)
+        for path in self._fetch_dicts(work):
+            stream = DictStream(path)
+            yield from (apply_rules(rules, stream) if rules else stream)
+
     def process_work(self, work: dict) -> WorkResult:
         t0 = time.time()
+        # Intra-unit resume (the hashcat --session analog): _progress
+        # carries completed-candidate count and prior founds; the stream
+        # is deterministic, so skipping replays exactly the unfinished
+        # tail (at-least-once: a half-done batch is re-tried).
+        # Persist the snapshot as-read (progress included) BEFORE popping:
+        # a crash during the skip fast-forward below must not regress the
+        # checkpoint to zero.
         self._write_resume(work)
+        progress = work.pop("_progress", None) or {}
+        skip = int(progress.get("done", 0))
+        prior_cand = list(progress.get("cand", []))
         engine = M22000Engine(
             work["hashes"], nc=self.cfg.nc, batch_size=self.cfg.batch_size
         )
         founds = []
-        tried = 0
+        done = skip
 
-        def run_pass(candidates):
-            nonlocal tried
-            batch = []
-            for pw in candidates:
-                if not engine.groups:
-                    return
-                batch.append(pw)
-                if len(batch) == engine.batch_size:
-                    tried += len(batch)
-                    founds.extend(engine.crack_batch(batch))
-                    batch = []
-            if batch and engine.groups:
-                tried += len(batch)
-                founds.extend(engine.crack_batch(batch))
+        def on_batch(consumed, new_founds):
+            nonlocal done
+            done += consumed
+            founds.extend(new_founds)
+            work["_progress"] = {
+                "done": done,
+                "cand": prior_cand
+                + [{"k": f.line.mac_ap.hex(), "v": f.psk.hex()} for f in founds],
+            }
+            self._write_resume(work)
 
-        # pass 1: targeted, no rules
-        run_pass(self._targeted_candidates(engine, work))
-        # pass 2: server dicts through server rules
-        rules = self._rules(work)
-        for path in self._fetch_dicts(work):
-            stream = DictStream(path)
-            run_pass(apply_rules(rules, stream) if rules else stream)
+        stream = self._all_candidates(engine, work)
+        if skip:
+            self.log(f"resuming work unit at candidate {skip}")
+            for _ in itertools.islice(stream, skip):
+                pass
+        engine.crack(stream, on_batch=on_batch)
+        tried = done - skip
 
         elapsed = time.time() - t0
         st = engine.stage_times
@@ -248,9 +281,12 @@ class TpuCrackClient:
         )
         if founds:
             self._record_founds(founds)
-        cand = [
+        # prior founds from a resumed session are re-submitted: put_work
+        # is idempotent server-side and the claim may not have landed
+        cand = prior_cand + [
             {"k": f.line.mac_ap.hex(), "v": f.psk.hex()} for f in founds
         ]
+        cand = [dict(t) for t in {tuple(sorted(c.items())) for c in cand}]
         result.accepted = self.api.put_work(work["hkey"], cand)
         self._clear_resume()
         self._autotune(elapsed)

@@ -500,26 +500,39 @@ class M22000Engine:
             return []
         return self._collect(self._dispatch(prep))
 
-    def crack(self, candidates) -> list:
+    def crack(self, candidates, on_batch=None) -> list:
         """Stream candidates in engine-sized batches until exhausted.
 
         Two-deep software pipeline: while the device crunches batch N, the
         host decodes/packs batch N+1 and enqueues its (async) H2D copy, so
         PBKDF2 compute hides the candidate transfer instead of serializing
         behind it — the double-buffering SURVEY.md §7.3.3 calls for.
+
+        ``on_batch(consumed, founds)`` is invoked after each batch
+        completes (consumed = raw candidates in that batch, founds = its
+        Found list) — the checkpoint seam the client's intra-unit resume
+        hangs off (the hashcat ``--session`` analog, help_crack.py:773).
         """
         founds = []
-        in_flight = None
+        in_flight = None   # (dispatched, raw_count)
         batch = []
+
+        def finish(dispatched, raw):
+            new = self._collect(dispatched)
+            founds.extend(new)
+            if on_batch is not None:
+                on_batch(raw, new)
 
         def submit(b):
             nonlocal in_flight
             prep = self._prepare(b)        # async H2D starts here
             if in_flight is not None:
-                founds.extend(self._collect(in_flight))  # sync on batch N
+                finish(*in_flight)         # sync on batch N
                 in_flight = None
             if prep is not None and self.groups:
-                in_flight = self._dispatch(prep)         # launch batch N+1
+                in_flight = (self._dispatch(prep), len(b))  # launch N+1
+            elif on_batch is not None:
+                on_batch(len(b), [])       # nothing dispatchable: still consumed
 
         for pw in candidates:
             if not self.groups and in_flight is None:
@@ -531,5 +544,5 @@ class M22000Engine:
         if batch:
             submit(batch)
         if in_flight is not None:
-            founds.extend(self._collect(in_flight))
+            finish(*in_flight)
         return founds

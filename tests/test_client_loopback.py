@@ -230,3 +230,48 @@ def test_prdict_pass1_candidates(server, tmp_path):
     assert any(f.psk == probed_psk for f in res.founds)
     rows = server.db.q("SELECT n_state, pass FROM nets")
     assert all(r["n_state"] == 1 and r["pass"] == probed_psk for r in rows)
+
+
+def test_intra_unit_checkpoint_written(server, tmp_path, monkeypatch):
+    """_progress (done counter + founds) is checkpointed after every
+    completed batch — the hashcat --session analog (SURVEY.md §5.4)."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="ck1")])
+    words = [b"filler-%06d" % i for i in range(40)] + [PSK]
+    _add_dict(server, words)
+    client = _client(server, tmp_path, batch_size=16)
+    snapshots = []
+    real_write = client._write_resume
+    monkeypatch.setattr(
+        client, "_write_resume",
+        lambda work: (snapshots.append(json.loads(json.dumps(work))),
+                      real_write(work))[1],
+    )
+    work = client.api.get_work(client.dictcount)
+    res = client.process_work(work)
+    assert res.accepted and [f.psk for f in res.founds] == [PSK]
+    dones = [s["_progress"]["done"] for s in snapshots if "_progress" in s]
+    assert dones and dones == sorted(dones) and dones[-1] >= len(words)
+    # the found PSK was checkpointed before put_work
+    assert any(s["_progress"]["cand"] for s in snapshots if "_progress" in s)
+
+
+def test_resume_skips_done_and_resubmits_founds(server, tmp_path):
+    """A resumed unit skips the completed prefix and re-submits prior
+    founds (which may not have reached the server before the crash)."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="ck2")])
+    net = server.db.q1("SELECT bssid FROM nets")
+    from dwpa_tpu.server.db import long2mac
+    mac = long2mac(net["bssid"])
+    # dict whose PSK sits inside the "already done" prefix
+    _add_dict(server, [PSK] + [b"filler-%06d" % i for i in range(40)])
+    client = _client(server, tmp_path, batch_size=16)
+    work = client.api.get_work(client.dictcount)
+    work["_progress"] = {
+        "done": 10 ** 6,  # far past the whole stream: nothing re-tried
+        "cand": [{"k": mac.hex(), "v": PSK.hex()}],
+    }
+    res = client.process_work(work)
+    assert res.candidates_tried == 0 and res.founds == []
+    assert res.accepted
+    row = server.db.q1("SELECT n_state, pass FROM nets")
+    assert row["n_state"] == 1 and row["pass"] == PSK
