@@ -1,0 +1,85 @@
+"""Native (C++) fast paths, loaded via ctypes.
+
+``capture_fast`` is the bulk pcap/pcapng -> m22000 extractor
+(capture_fast.cpp), the native seat the reference fills with
+hcxpcapngtool (web/common.php:481).  The shared library is built on
+demand with the toolchain's g++ and cached next to the source; loading
+degrades gracefully (``load() -> None``) so every caller keeps the pure
+Python parser as fallback — the native path is an optimization, never a
+requirement.
+"""
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "capture_fast.cpp")
+_SO = os.path.join(_DIR, "capture_fast.so")
+_lib = None
+_tried = False
+
+
+def build(force: bool = False) -> str:
+    """Compile capture_fast.so if missing/stale; returns the .so path."""
+    if (not force and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+        check=True, capture_output=True,
+    )
+    return _SO
+
+
+def load(auto_build: bool = True):
+    """ctypes handle to the native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried and not auto_build:
+        return None
+    _tried = True
+    try:
+        if auto_build:
+            build()
+        lib = ctypes.CDLL(_SO)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    lib.dwpa_extract.restype = ctypes.c_int
+    lib.dwpa_extract.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.dwpa_free.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def extract_hashlines_fast(blob: bytes, nc_hint: bool = True):
+    """Native twin of server.capture.extract_hashlines.
+
+    Returns ([hashline str, ...], [probe ssid bytes, ...]); raises
+    RuntimeError when the library is unavailable (callers select the
+    fast path explicitly and fall back themselves).
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native capture parser unavailable (g++ build failed?)")
+    out = ctypes.c_char_p()
+    out_len = ctypes.c_size_t()
+    rc = lib.dwpa_extract(blob, len(blob), int(nc_hint),
+                          ctypes.byref(out), ctypes.byref(out_len))
+    if rc != 0:
+        raise RuntimeError(f"dwpa_extract failed: rc={rc}")
+    try:
+        text = ctypes.string_at(out, out_len.value)
+    finally:
+        lib.dwpa_free(out)
+    lines, probes = [], []
+    for rec in text.split(b"\n"):
+        if rec.startswith(b"H "):
+            lines.append(rec[2:].decode("ascii"))
+        elif rec.startswith(b"P "):
+            probes.append(bytes.fromhex(rec[2:].decode("ascii")))
+    return lines, probes

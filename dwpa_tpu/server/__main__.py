@@ -19,16 +19,36 @@ import sys
 import time
 
 
+def _load_conf(args):
+    """Overlay a JSON conf file (the web/conf.php equivalent surface:
+    db path, artifact dirs, bosskey, bind address, public base_url)
+    under any explicitly passed flags — flags win."""
+    path = getattr(args, "conf", None)
+    if not path:
+        return {}
+    with open(path) as f:
+        conf = json.load(f)
+    for key in ("db", "dictdir", "capdir", "hcdir", "bosskey", "host",
+                "port", "base_url"):
+        if key in conf and getattr(args, key, None) is None:
+            setattr(args, key, conf[key])
+    return conf
+
+
 def _core(args):
     from .core import ServerCore
     from .db import Database
 
+    _load_conf(args)
+    if not getattr(args, "db", None):
+        raise SystemExit("--db (or a conf file with a 'db' key) is required")
     return ServerCore(
         Database(args.db),
         dictdir=getattr(args, "dictdir", None) or "dicts",
         capdir=getattr(args, "capdir", None) or "caps",
         bosskey=getattr(args, "bosskey", None),
         hcdir=getattr(args, "hcdir", None),
+        base_url=getattr(args, "base_url", None) or "",
     )
 
 
@@ -38,8 +58,10 @@ def cmd_serve(args):
     from .api import make_wsgi_app
 
     app = make_wsgi_app(_core(args))
-    with make_server(args.host, args.port, app) as srv:
-        print(f"dwpa_tpu server on http://{args.host}:{args.port}/", flush=True)
+    host = args.host or "127.0.0.1"
+    port = args.port if args.port is not None else 8080
+    with make_server(host, port, app) as srv:
+        print(f"dwpa_tpu server on http://{host}:{port}/", flush=True)
         srv.serve_forever()
 
 
@@ -89,15 +111,18 @@ def cmd_dedup_dicts(args):
 
 
 def cmd_fill_pr(args):
-    from .tools import fill_pr
+    from .tools import fill_pr, get_extractor
 
-    print(json.dumps(fill_pr(_core(args), limit=args.limit)))
+    ex = get_extractor(native=args.native)
+    print(json.dumps(fill_pr(_core(args), limit=args.limit, extractor=ex)))
 
 
 def cmd_enrich(args):
-    from .tools import enrich_message_pair
+    from .tools import enrich_message_pair, get_extractor
 
-    print(json.dumps(enrich_message_pair(_core(args), limit=args.limit)))
+    ex = get_extractor(native=args.native)
+    print(json.dumps(
+        enrich_message_pair(_core(args), limit=args.limit, extractor=ex)))
 
 
 def main(argv=None):
@@ -105,14 +130,18 @@ def main(argv=None):
     sub = p.add_subparsers(dest="cmd", required=True)
 
     def common(sp, db_required=True):
-        sp.add_argument("--db", required=db_required, help="sqlite path")
+        sp.add_argument("--db", help="sqlite path")
+        sp.add_argument("--conf", help="JSON conf file (web/conf.php "
+                                       "equivalent); flags override it")
         sp.add_argument("--dictdir")
         sp.add_argument("--capdir")
 
     sp = sub.add_parser("serve", help="run the HTTP API + UI")
     common(sp)
-    sp.add_argument("--host", default="127.0.0.1")
-    sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("--host", default=None, help="bind address (default 127.0.0.1)")
+    sp.add_argument("--port", type=int, default=None,
+                    help="port (default 8080; 0 = OS-assigned)")
+    sp.add_argument("--base-url", dest="base_url", help="public URL for mailed links")
     sp.add_argument("--bosskey", help="32-hex superuser key (conf.php)")
     sp.add_argument("--hcdir", help="client-distribution dir (web/hc/): "
                                     "dwpa_tpu.version + dwpa_tpu.pyz")
@@ -147,11 +176,15 @@ def main(argv=None):
     sp = sub.add_parser("fill-pr", help="backfill probe-request tables")
     common(sp)
     sp.add_argument("--limit", type=int)
+    sp.add_argument("--native", action="store_true",
+                    help="use the C++ bulk parser (native/capture_fast)")
     sp.set_defaults(fn=cmd_fill_pr)
 
     sp = sub.add_parser("enrich", help="backfill message_pair from captures")
     common(sp)
     sp.add_argument("--limit", type=int)
+    sp.add_argument("--native", action="store_true",
+                    help="use the C++ bulk parser (native/capture_fast)")
     sp.set_defaults(fn=cmd_enrich)
 
     args = p.parse_args(argv)

@@ -56,6 +56,8 @@ def _pcap_frames(data: bytes):
         endian = ">"
     else:
         raise ValueError("not a pcap file")
+    if len(data) < 24:
+        return  # truncated global header: no frames, not a crash
     linktype = struct.unpack_from(endian + "I", data, 20)[0] & 0xFFFF
     off = 24
     while off + 16 <= len(data):
@@ -78,14 +80,14 @@ def _pcapng_frames(data: bytes):
         if blen < 12 or off + blen > len(data):
             break
         body = data[off + 8 : off + blen - 4]
-        if btype == 0x00000001:  # IDB
+        if btype == 0x00000001 and len(body) >= 2:  # IDB
             ifaces.append(struct.unpack_from(endian + "H", body, 0)[0])
         elif btype == 0x00000006 and len(body) >= 20:  # EPB
             iface, _, _, caplen, _ = struct.unpack_from(endian + "IIIII", body, 0)
             frame = body[20 : 20 + caplen]
             lt = ifaces[iface] if iface < len(ifaces) else DLT_IEEE802_11
             yield lt, frame
-        elif btype == 0x00000003:  # Simple Packet Block
+        elif btype == 0x00000003 and len(body) >= 4:  # Simple Packet Block
             lt = ifaces[0] if ifaces else DLT_IEEE802_11
             caplen = struct.unpack_from(endian + "I", body, 0)[0]
             yield lt, body[4 : 4 + caplen]
@@ -180,7 +182,8 @@ def _parse_eapol_key(ap: bytes, sta: bytes, eapol: bytes):
         while off + 2 <= len(key_data):
             t, ln = key_data[off], key_data[off + 1]
             chunk = key_data[off + 2 : off + 2 + ln]
-            if t == 0xDD and ln >= 20 and chunk[:4] == b"\x00\x0f\xac\x04":
+            if (t == 0xDD and ln >= 20 and len(chunk) >= 20
+                    and chunk[:4] == b"\x00\x0f\xac\x04"):
                 pmkid = chunk[4:20]
                 if any(pmkid) and pmkid != b"\xff" * 16:
                     pmkids.append(pmkid)

@@ -196,6 +196,42 @@ def keygen_precompute(core: ServerCore, limit: int = 100,
     return {"processed": len(nets), "cracked": found}
 
 
+def psk_lookup(core: ServerCore, lookup, batch: int = 100) -> dict:
+    """External PSK-database sweep (3wifi.php equivalent).
+
+    Batches up to ``batch`` uncracked, not-yet-queried BSSIDs through
+    ``lookup(macs: list[bytes]) -> dict[mac_bytes, psk_bytes]`` and
+    submits every hit through the normal put_work verification path —
+    the external database is never trusted, exactly as the reference
+    routes 3wifi answers through full re-verification (3wifi.php:66).
+    flags bit 1 marks queried bssids (wpa.sql:16) so each is asked once.
+    """
+    rows = core.db.q(
+        """SELECT DISTINCT n.bssid FROM nets n
+           JOIN bssids b ON b.bssid = n.bssid
+           WHERE n.n_state = 0 AND b.flags & 1 = 0 LIMIT ?""", (batch,)
+    )
+    macs = [long2mac(r["bssid"]) for r in rows]
+    if not macs:
+        return {"queried": 0, "submitted": 0}
+    found = lookup(macs) or {}
+    cand = [{"k": mac.hex(), "v": psk.hex()} for mac, psk in found.items()]
+    # put_work caps candidates per call (MAX_CANDS_PER_PUT, matching the
+    # reference's 200-pair limit) — chunk so no hit is silently dropped.
+    from .core import MAX_CANDS_PER_PUT
+
+    for i in range(0, len(cand), MAX_CANDS_PER_PUT):
+        core.put_work({"type": "bssid",
+                       "cand": cand[i:i + MAX_CANDS_PER_PUT],
+                       "ip": "psk_lookup"})
+    for r in rows:
+        core.db.x(
+            "UPDATE bssids SET flags = flags | 1 WHERE bssid = ?",
+            (r["bssid"],),
+        )
+    return {"queried": len(macs), "submitted": len(cand)}
+
+
 def geolocate(core: ServerCore, lookup, batch: int = 5) -> int:
     """Enrich bssids rows via ``lookup(mac: bytes) -> dict|None`` with keys
     lat/lon/country/region/city (wigle.php equivalent; the reference

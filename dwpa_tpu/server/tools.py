@@ -164,16 +164,34 @@ def _archived_captures(core: ServerCore, limit: int = None):
             continue
 
 
-def fill_pr(core: ServerCore, limit: int = None) -> dict:
+def get_extractor(native: bool = False):
+    """Select the capture extractor: the Python specification parser or
+    the C++ fast path (native/capture_fast) for bulk re-parses.  The
+    native library is differentially tested against the Python one
+    (tests/test_native_capture.py); unavailability falls back silently.
+    """
+    if native:
+        try:
+            from ..native import extract_hashlines_fast, load
+
+            if load() is not None:
+                return extract_hashlines_fast
+        except (ImportError, RuntimeError):
+            pass
+    return extract_hashlines
+
+
+def fill_pr(core: ServerCore, limit: int = None, extractor=None) -> dict:
     """Re-parse archived captures into the PROBEREQUEST tables.
 
     The dynamic-dict source (prs/p2s) for captures ingested before the
     probe-harvest path existed (fill_pr.php:33-71).  INSERT OR IGNORE
     keyed on (ssid) / (p_id, s_id) makes re-runs free.
     """
+    extractor = extractor or extract_hashlines
     subs = probes = 0
     for s_id, blob in _archived_captures(core, limit):
-        _, prs = extract_hashlines(blob)
+        _, prs = extractor(blob)
         if prs:
             core.add_probe_requests(prs, s_id)
             probes += len(prs)
@@ -181,7 +199,8 @@ def fill_pr(core: ServerCore, limit: int = None) -> dict:
     return {"submissions": subs, "probes": probes}
 
 
-def enrich_message_pair(core: ServerCore, limit: int = None) -> dict:
+def enrich_message_pair(core: ServerCore, limit: int = None,
+                        extractor=None) -> dict:
     """Backfill message-pair info on nets whose stored line lacks it.
 
     Re-parses each archived capture and, for any net matching by m22000
@@ -189,9 +208,10 @@ def enrich_message_pair(core: ServerCore, limit: int = None) -> dict:
     common.php:310-315), replaces a NULL message_pair with the freshly
     parsed line's value (enrich_pmkid.php:44-68).
     """
+    extractor = extractor or extract_hashlines
     updated = 0
     for s_id, blob in _archived_captures(core, limit):
-        lines, _ = extract_hashlines(blob)
+        lines, _ = extractor(blob)
         for line in lines:
             try:
                 h = hl.parse(line)

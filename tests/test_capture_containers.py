@@ -140,3 +140,18 @@ def test_m4_zero_snonce_not_paired():
                            m4_snonce=False)
     lines, _ = extract_hashlines(blob)
     assert lines == []
+
+
+def test_malformed_pcapng_blocks_tolerated():
+    """Empty IDB/SPB bodies must be skipped, not crash extraction."""
+    import struct
+
+    def block(btype, body):
+        total = 12 + len(body) + (-len(body)) % 4
+        return (struct.pack("<II", btype, total) + body
+                + b"\x00" * ((-len(body)) % 4) + struct.pack("<I", total))
+
+    shb = block(0x0A0D0D0A, struct.pack("<I", 0x1A2B3C4D) + struct.pack("<HHq", 1, 0, -1))
+    bad = shb + block(1, b"") + block(3, b"") + block(6, b"\x00" * 8)
+    lines, probes = extract_hashlines(bad)
+    assert lines == [] and probes == []

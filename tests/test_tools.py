@@ -174,3 +174,56 @@ def test_cli_jobs_once(tmp_path, capsys):
     cli_main(["jobs", "--db", db])
     out = json.loads(capsys.readouterr().out)
     assert "maintenance" in out and "keygen" in out
+
+
+# ---------------------------------------------------------------------------
+# psk_lookup (3wifi.php equivalent) and conf-file loading
+
+
+def test_psk_lookup_submits_through_verification(core):
+    line = tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="pl1")
+    core.add_hashlines([line])
+    net = core.db.q1("SELECT * FROM nets")
+    from dwpa_tpu.server.db import long2mac
+    from dwpa_tpu.server.jobs import psk_lookup
+
+    mac = long2mac(net["bssid"])
+    calls = []
+
+    def lookup(macs):
+        calls.append(macs)
+        # external DB knows this PSK plus a wrong one that must not stick
+        return {m: (PSK if m == mac else b"garbage-psk") for m in macs}
+
+    out = psk_lookup(core, lookup)
+    assert out == {"queried": 1, "submitted": 1}
+    row = core.db.q1("SELECT n_state, pass FROM nets")
+    assert row["n_state"] == 1 and row["pass"] == PSK
+    # queried flag set -> not asked again
+    assert psk_lookup(core, lookup) == {"queried": 0, "submitted": 0}
+    assert calls == [[mac]]
+
+
+def test_psk_lookup_rejects_wrong_answers(core):
+    core.add_hashlines([tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="pl2")])
+    from dwpa_tpu.server.jobs import psk_lookup
+
+    out = psk_lookup(core, lambda macs: {m: b"wrong-psk-111" for m in macs})
+    assert out["submitted"] == 1
+    # the claim failed independent re-verification; net stays uncracked
+    assert core.db.q1("SELECT n_state FROM nets")["n_state"] == 0
+
+
+def test_cli_conf_file(tmp_path, capsys):
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "db": str(tmp_path / "conf.db"),
+        "dictdir": str(tmp_path / "cd"),
+    }))
+    cli_main(["recrack", "--conf", str(conf)])
+    assert json.loads(capsys.readouterr().out) == {"checked": 0}
+
+
+def test_cli_requires_db_or_conf(tmp_path):
+    with pytest.raises(SystemExit):
+        cli_main(["recrack"])
