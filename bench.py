@@ -96,6 +96,64 @@ def bench_engine_dict(line: str, psk: bytes, words: int, label: str) -> dict:
     return {"label": label, "words": words, "seconds": dt, "pmk_per_s": words / dt}
 
 
+def bench_rules_dict(words: int) -> dict:
+    """Config #3: dict expanded through hashcat rules, engine end-to-end.
+
+    A representative rule set (case/append/prepend/truncate families, the
+    op classes bestWPA.rule uses); throughput counts expanded candidates.
+    """
+    from dwpa_tpu.rules import apply_rules, parse_rules
+
+    rules = parse_rules([":", "u", "c", "$1", "^w", "r", "T0", "$1 $2 $3"])
+    base = [b"benchword%04d" % i for i in range(words)]
+    # The planted PSK is the LAST base word through the LAST rule — the
+    # final expanded candidate — so the engine's early exit on the find
+    # cannot shrink the work that the candidates/second figure counts.
+    expanded_psk = b"benchword%04d123" % (words - 1)
+    engine = M22000Engine(
+        [T.make_pmkid_line(expanded_psk, b"bench-essid", seed="rules")],
+        batch_size=min(4096, words),
+    )
+    engine.crack_batch([b"warm-%06d" % i for i in range(engine.batch_size)])
+    t0 = time.perf_counter()
+    founds = engine.crack(apply_rules(rules, base))
+    dt = time.perf_counter() - t0
+    assert founds and founds[0].psk == expanded_psk, "rules config missed the PSK"
+    n = words * len(rules)
+    return {"label": "rules_dict", "candidates": n, "seconds": dt,
+            "cand_per_s": n / dt}
+
+
+def bench_multi_bssid(words: int) -> dict:
+    """Config #4: multi-BSSID work unit with ESSID-dedup amortization.
+
+    5 nets share one ESSID (one PBKDF2 serves all five, the scheduler's
+    grouping trick, get_work.php:96-109) plus 3 distinct-ESSID nets; the
+    effective net-checks/s exceeds raw PMK/s by the sharing factor.
+    """
+    psk = b"benchpass4"
+    lines = [T.make_eapol_line(psk, b"bench-shared", keyver=2, seed=f"mb{i}")
+             for i in range(4)]
+    lines.append(T.make_pmkid_line(psk, b"bench-shared", seed="mb4"))
+    lines += [T.make_pmkid_line(psk, b"bench-solo-%d" % i, seed=f"ms{i}")
+              for i in range(3)]
+    n_nets, n_essids = len(lines), 4
+    dict_words = [b"candidate-%06d" % i for i in range(words - 1)] + [psk]
+    engine = M22000Engine(lines, batch_size=min(4096, words))
+    engine.crack_batch([b"warm-%06d" % i for i in range(engine.batch_size)])
+    t0 = time.perf_counter()
+    founds = engine.crack(dict_words)
+    dt = time.perf_counter() - t0
+    assert len(founds) == n_nets, f"multi-bssid: {len(founds)}/{n_nets} cracked"
+    return {"label": "multi_bssid", "nets": n_nets, "essids": n_essids,
+            "seconds": dt, "pmk_per_s": words * n_essids / dt,
+            "net_checks_per_s": words * n_nets / dt}
+
+
+def _round(cfg: dict) -> dict:
+    return {k: round(v, 4) if isinstance(v, float) else v for k, v in cfg.items()}
+
+
 def main():
     batch = 131072 if ON_TPU else 2048
     words = 1000
@@ -108,6 +166,8 @@ def main():
     eapol = bench_engine_dict(
         T.make_eapol_line(psk, b"bench-essid", keyver=2), psk, words, "eapol_dict"
     )
+    rules = bench_rules_dict(words)
+    multi = bench_multi_bssid(words)
 
     value = mask["pmk_per_s"]
     print(
@@ -119,12 +179,11 @@ def main():
                 "vs_baseline": round(value / PER_CHIP_TARGET, 4),
                 "platform": jax.devices()[0].device_kind,
                 "configs": {
-                    "mask_pbkdf2": {k: round(v, 4) if isinstance(v, float) else v
-                                    for k, v in mask.items()},
-                    "pmkid_dict": {k: round(v, 4) if isinstance(v, float) else v
-                                   for k, v in pmkid.items()},
-                    "eapol_dict": {k: round(v, 4) if isinstance(v, float) else v
-                                   for k, v in eapol.items()},
+                    "mask_pbkdf2": _round(mask),
+                    "pmkid_dict": _round(pmkid),
+                    "eapol_dict": _round(eapol),
+                    "rules_dict": _round(rules),
+                    "multi_bssid": _round(multi),
                 },
             }
         )

@@ -369,9 +369,15 @@ class M22000Engine:
         self.batch_size = -(-int(batch_size) // n) * n
         self.nc = nc
         self.verify_with_oracle = verify_with_oracle
-        self.groups = {}  # essid -> list[PreppedNet]
+        self.groups = {}  # essid -> list[PreppedNet] (live/uncracked view)
         self.skipped = []
-        self._steps = {}  # essid -> (n_nets, jitted crack step)
+        # Step traces bake the group's net constants in, so they are
+        # built once per ESSID group over its FULL original membership
+        # and never rebuilt: a find masks its net host-side in _collect
+        # instead of shrinking the traced shapes, which would otherwise
+        # recompile the whole step (~tens of seconds on TPU) per crack.
+        self._full = {}   # essid -> original list[PreppedNet]
+        self._steps = {}  # essid -> jitted crack step
         # Per-stage wall-clock accumulators (SURVEY.md §5.1): host pack +
         # H2D enqueue / device dispatch / sync + decode.  "collect" is
         # where device compute surfaces under the async runtime.
@@ -384,6 +390,7 @@ class M22000Engine:
                 self.skipped.append(line)
                 continue
             self.groups.setdefault(h.essid, []).append(net)
+        self._full = {e: list(g) for e, g in self.groups.items()}
         self._salts = {e: essid_salt_blocks(e) for e in self.groups}
 
     @property
@@ -400,18 +407,18 @@ class M22000Engine:
             del self.groups[found.line.essid]
             del self._salts[found.line.essid]
             self._steps.pop(found.line.essid, None)
+            self._full.pop(found.line.essid, None)
 
-    def _step_for(self, essid: bytes, group: list):
-        """The jitted mesh crack step for one ESSID group (cached until
-        the group shrinks after a find)."""
+    def _step_for(self, essid: bytes):
+        """The jitted mesh crack step for one ESSID group, traced once
+        over the group's full original membership (see __init__)."""
         from ..parallel import build_crack_step
 
-        cached = self._steps.get(essid)
-        if cached and cached[0] == len(group):
-            return cached[1]
-        s1, s2 = self._salts[essid]
-        step = build_crack_step(self.mesh, list(group), s1, s2)
-        self._steps[essid] = (len(group), step)
+        step = self._steps.get(essid)
+        if step is None:
+            s1, s2 = self._salts[essid]
+            step = build_crack_step(self.mesh, list(self._full[essid]), s1, s2)
+            self._steps[essid] = step
         return step
 
     def _prepare(self, passwords):
@@ -442,13 +449,18 @@ class M22000Engine:
         return pws, nvalid, pw_words
 
     def _dispatch(self, prep):
-        """Launch the crack step for every live ESSID group (no host sync)."""
+        """Launch the crack step for every live ESSID group (no host sync).
+
+        The step always runs over the group's full original membership
+        (cracked nets included — their extra MIC checks are noise next to
+        the shared PBKDF2); _collect masks the dead rows.
+        """
         t0 = time.perf_counter()
         pws, nvalid, pw_words = prep
         outs = []
-        for essid, group in list(self.groups.items()):
-            step = self._step_for(essid, group)
-            outs.append((list(group), step(pw_words)))
+        for essid in list(self.groups):
+            step = self._step_for(essid)
+            outs.append((self._full[essid], step(pw_words)))
         self.stage_times["dispatch"] += time.perf_counter() - t0
         return pws, nvalid, outs
 
@@ -457,6 +469,7 @@ class M22000Engine:
         t0 = time.perf_counter()
         pws, nvalid, outs = dispatched
         founds = []
+        live = {id(n.line) for g in self.groups.values() for n in g}
         for group, (hits, found_dev, pmk_dev) in outs:
             # The psum hits-gate: one replicated scalar is the only
             # device->host sync on the (overwhelmingly common) all-miss
@@ -467,6 +480,8 @@ class M22000Engine:
             found[:, :, nvalid:] = False
             pmk_host = np.asarray(pmk_dev)
             for ni, net in enumerate(group):
+                if id(net.line) not in live:
+                    continue  # already cracked; the step still computes it
                 nf = found[ni]  # [V_max, B]
                 hit_cols = np.flatnonzero(nf.any(axis=0))
                 for b in hit_cols:

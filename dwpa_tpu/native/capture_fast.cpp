@@ -265,6 +265,7 @@ int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint, char** out,
     OrderedMap<std::vector<std::pair<Bytes, int>>> essids;
     std::vector<Bytes> probes;
     OrderedMap<std::vector<EapolMsg>> ap_msgs, sta_msgs;  // key: ap||sta
+    OrderedMap<std::vector<Bytes>> ap_nonces;             // key: ap
     std::vector<std::pair<Bytes, Bytes>> pmkid_keys;      // dedup keys seen
     struct PmkidRow { Bytes ap, sta, pmkid; };
     std::vector<PmkidRow> pmkid_rows;
@@ -328,6 +329,7 @@ int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint, char** out,
         if (!parse_eapol_key(ap, sta, eapol, elen, m)) continue;
         Bytes key = ap + sta;
         (m.num == 1 || m.num == 3 ? ap_msgs : sta_msgs).get(key).push_back(m);
+        if (m.num == 1 || m.num == 3) ap_nonces.get(ap).push_back(m.nonce);
         for (auto& pk : m.pmkids) {
             bool seen = false;
             for (auto& row : pmkid_rows)
@@ -335,6 +337,38 @@ int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint, char** out,
             if (!seen) pmkid_rows.push_back({ap, sta, pk});
         }
     }
+
+    // Observed nonce-increment endianness -> MP_LE (0x20) / MP_BE (0x40)
+    // hint bits, mirroring the Python parser's endian_bits().  Memoized
+    // per AP: ap_nonces is immutable by the time the pairing loop runs,
+    // and one AP can emit many handshake lines.
+    OrderedMap<int> endian_cache;
+    auto endian_bits = [&](const Bytes& ap) -> int {
+        if (int* hit = endian_cache.find(ap)) return *hit;
+        bool le = false, be = false;
+        int& slot = endian_cache.get(ap);
+        auto* nonces = ap_nonces.find(ap);
+        if (!nonces) return slot = 0;
+        for (size_t i = 0; i + 1 < nonces->size(); i++) {
+            const Bytes& a = (*nonces)[i];
+            const Bytes& b = (*nonces)[i + 1];
+            if (a == b || a.compare(0, 28, b, 0, 28) != 0) continue;
+            const uint8_t* ap4 = (const uint8_t*)a.data() + 28;
+            const uint8_t* bp4 = (const uint8_t*)b.data() + 28;
+            bool hit = false;
+            for (int isle = 1; isle >= 0 && !hit; isle--) {
+                uint32_t av = isle ? rd32(ap4, false) : rd32(ap4, true);
+                uint32_t bv = isle ? rd32(bp4, false) : rd32(bp4, true);
+                int64_t d = (int64_t)(uint32_t)(bv - av);
+                if (d >= 0x80000000LL) d -= 0x100000000LL;
+                if (d != 0 && (d < 0 ? -d : d) <= 128) {
+                    (isle ? le : be) = true;
+                    hit = true;
+                }
+            }
+        }
+        return slot = (le != be ? (le ? 0x20 : 0x40) : 0);
+    };
 
     auto best_essid = [&](const Bytes& ap, Bytes& out_ssid) {
         auto* vec = essids.find(ap);
@@ -373,7 +407,7 @@ int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint, char** out,
                 for (auto& am : *aps) {
                     if (am.num != pr.ap_num) continue;
                     if ((int64_t)(am.replay - sm.replay) != pr.delta) continue;
-                    int mp = pr.mp | (nc_hint ? 0x80 : 0);
+                    int mp = pr.mp | (nc_hint ? 0x80 : 0) | endian_bits(ap);
                     text += "H " +
                             serialize(2, sm.mic, ap, sm.sta, essid, am.nonce,
                                       sm.frame, mp) +

@@ -1,4 +1,4 @@
 """Device-mesh data parallelism for the cracking pipeline."""
 
-from .mesh import default_mesh, shard_candidates  # noqa: F401
+from .mesh import default_mesh, multihost_mesh, shard_candidates  # noqa: F401
 from .step import build_crack_step  # noqa: F401

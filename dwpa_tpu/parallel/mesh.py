@@ -29,5 +29,53 @@ def default_mesh(devices=None, n: int = None) -> Mesh:
 
 
 def shard_candidates(mesh: Mesh, pw_words):
-    """Place a packed [B, 16] candidate batch with B split over the mesh."""
-    return jax.device_put(pw_words, NamedSharding(mesh, P(DP_AXIS, None)))
+    """Place a packed [B, 16] candidate batch with B split over the mesh.
+
+    Single-process: ``pw_words`` is the whole batch, placed under the dp
+    sharding.  Multi-process (a ``multihost_mesh`` spanning hosts):
+    ``pw_words`` is this host's *local* shard, assembled into the global
+    array with ``jax.make_array_from_process_local_data`` — device_put
+    cannot express "local slice of a global array" across non-addressable
+    devices.
+    """
+    sharding = NamedSharding(mesh, P(DP_AXIS, None))
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, np.asarray(pw_words))
+    return jax.device_put(pw_words, sharding)
+
+
+def multihost_mesh(coordinator: str = None, num_processes: int = None,
+                   process_id: int = None) -> Mesh:
+    """A 1-D dp mesh spanning every chip of a multi-host slice.
+
+    The distributed backend analog of the reference's NCCL/MPI role
+    (SURVEY.md §5.8): ``jax.distributed.initialize`` wires the hosts
+    (args default to the TPU environment's auto-detection), and the mesh
+    covers ``jax.devices()`` — the *global* device list — so the same
+    shard_map crack step scales from one chip to a full slice unchanged.
+    Because the candidate axis is the only sharded axis and the hot loop
+    is traffic-free, the lone collective (the psum hits-gate) rides ICI
+    intra-host and DCN across hosts; its payload is one scalar per batch,
+    so DCN latency is irrelevant to throughput.
+
+    Each host feeds its local shard via ``shard_candidates`` (which
+    assembles host-local slices into the global array with
+    ``jax.make_array_from_process_local_data``); work-unit distribution
+    stays on the reference's HTTP/JSON WAN protocol — a multi-host slice
+    is simply one very large volunteer.
+    """
+    kw = {}
+    if coordinator is not None:
+        kw["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    if (coordinator is not None or num_processes is not None) and (
+        not jax.distributed.is_initialized()
+    ):
+        # Must run before anything touches the XLA backend (even
+        # jax.process_count() would initialise it), hence the check
+        # against the distributed-service state rather than device APIs.
+        jax.distributed.initialize(**kw)
+    return Mesh(np.asarray(jax.devices()), (DP_AXIS,))

@@ -271,6 +271,7 @@ def extract_hashlines(blob: bytes, nc_hint: bool = True):
     probes = []
     ap_msgs = defaultdict(list)         # (ap, sta) -> [EapolMsg 1/3]
     sta_msgs = defaultdict(list)        # (ap, sta) -> [EapolMsg 2/4]
+    ap_nonces = defaultdict(list)       # ap -> [anonce] in capture order
     pmkid_seen = set()
     pmkid_rows = []
 
@@ -292,6 +293,8 @@ def extract_hashlines(blob: bytes, nc_hint: bool = True):
             msg = payload
             bucket = ap_msgs if msg.num in (1, 3) else sta_msgs
             bucket[(msg.ap, msg.sta)].append(msg)
+            if msg.num in (1, 3):
+                ap_nonces[msg.ap].append(msg.nonce)
             for pmkid in msg.pmkids:
                 key = (msg.ap, msg.sta, pmkid)
                 if key not in pmkid_seen:
@@ -301,6 +304,40 @@ def extract_hashlines(blob: bytes, nc_hint: bool = True):
     def best_essid(ap):
         c = essids.get(ap)
         return c.most_common(1)[0][0] if c else None
+
+    endian_cache = {}
+
+    def endian_bits(ap):
+        """Observed nonce-increment endianness -> MP_LE/MP_BE hint bits.
+
+        hcxpcapngtool behavior: routers that increment the ANONCE between
+        retransmissions reveal whether the counter's last 4 bytes step as
+        little- or big-endian; the hint halves the verifier's NC search
+        (models/m22000._nc_variants honors it).  Ambiguous evidence
+        (both/neither) emits no hint — NC search stays two-sided.
+        Memoized per AP: ap_nonces is frozen before any line is emitted.
+        """
+        if ap in endian_cache:
+            return endian_cache[ap]
+        le = be = False
+        nonces = ap_nonces.get(ap, [])
+        for a, b in zip(nonces, nonces[1:]):
+            if a[:28] != b[:28] or a == b:
+                continue
+            for fmt, is_le in (("<I", True), (">I", False)):
+                d = (struct.unpack(fmt, b[28:])[0]
+                     - struct.unpack(fmt, a[28:])[0]) & 0xFFFFFFFF
+                if d >= 0x80000000:
+                    d -= 0x100000000
+                if 0 < abs(d) <= 128:
+                    if is_le:
+                        le = True
+                    else:
+                        be = True
+                    break
+        bits = (hl.MP_LE if le else hl.MP_BE) if le != be else 0
+        endian_cache[ap] = bits
+        return bits
 
     lines = []
     for ap, sta, pmkid in pmkid_rows:
@@ -325,7 +362,7 @@ def extract_hashlines(blob: bytes, nc_hint: bool = True):
                 for am in aps:
                     if am.num != ap_num or am.replay - sm.replay != delta:
                         continue
-                    mp_final = mp | (0x80 if nc_hint else 0)
+                    mp_final = mp | (0x80 if nc_hint else 0) | endian_bits(ap)
                     lines.append(
                         hl.serialize(
                             hl.TYPE_EAPOL, sm.mic, ap, sta, essid,

@@ -155,3 +155,60 @@ def test_malformed_pcapng_blocks_tolerated():
     bad = shb + block(1, b"") + block(3, b"") + block(6, b"\x00" * 8)
     lines, probes = extract_hashlines(bad)
     assert lines == [] and probes == []
+
+
+# ---------------------------------------------------------------------------
+# nonce-increment endianness hints (MP_LE/MP_BE, hcxpcapngtool behavior)
+
+
+def _retrans_capture(seed, endian="<", delta=1):
+    """M1(replay1, anonce) + M1(replay2, anonce+delta) + M2: a router
+    that increments its ANONCE between retransmissions."""
+    import struct
+
+    mac_ap = tfx._rand(seed + "ap", 6)
+    mac_sta = tfx._rand(seed + "sta", 6)
+    anonce = tfx._rand(seed + "anonce", 32)
+    snonce = tfx._rand(seed + "snonce", 32)
+    pmk = oracle.pmk_from_psk(PSK, ESSID)
+    last = struct.unpack(endian + "I", anonce[28:])[0]
+    anonce2 = anonce[:28] + struct.pack(endian + "I", (last + delta) & 0xFFFFFFFF)
+
+    zero = tfx.build_eapol_key_frame(0x010A, 1, snonce,
+                                     key_data=tfx._rand(seed + "kd", 22))
+    m = min(mac_ap, mac_sta) + max(mac_ap, mac_sta)
+    n = snonce + anonce if snonce[:6] < anonce[:6] else anonce + snonce
+    mic = oracle.compute_mic(pmk, 2, m, n, zero)
+    m2 = zero[:81] + mic + zero[97:]
+
+    frames = [
+        tfx.beacon_frame(mac_ap, ESSID),
+        tfx._dot11_data_eapol(mac_ap, mac_sta, mac_ap,
+                              tfx.build_eapol_key_frame(0x008A, 1, anonce),
+                              from_ds=True),
+        tfx._dot11_data_eapol(mac_ap, mac_sta, mac_ap,
+                              tfx.build_eapol_key_frame(0x008A, 2, anonce2),
+                              from_ds=True),
+        tfx._dot11_data_eapol(mac_sta, mac_ap, mac_ap, m2, from_ds=False),
+    ]
+    return tfx.pcap_bytes(frames)
+
+
+def test_le_increment_sets_le_hint():
+    lines = _lines_crack(_retrans_capture("le1", endian="<"), 1)
+    mp = hl.parse(lines[0]).message_pair
+    assert mp & hl.MP_LE and not mp & hl.MP_BE
+
+
+def test_be_increment_sets_be_hint():
+    lines = _lines_crack(_retrans_capture("be1", endian=">", delta=3), 1)
+    mp = hl.parse(lines[0]).message_pair
+    assert mp & hl.MP_BE
+
+
+def test_no_retransmission_no_hint():
+    lines = _lines_crack(tfx.pcap_bytes(FRAMES), EXPECTED)
+    for line in lines:
+        h = hl.parse(line)
+        if h.hash_type == hl.TYPE_EAPOL:
+            assert not h.message_pair & (hl.MP_LE | hl.MP_BE)

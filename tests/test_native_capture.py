@@ -115,3 +115,10 @@ def test_bulk_throughput_exceeds_python():
         extract_hashlines(blob)
     t_py = time.perf_counter() - t0
     assert t_fast < t_py
+
+
+def test_endian_hint_matches():
+    from test_capture_containers import _retrans_capture
+
+    for kw in ({"endian": "<"}, {"endian": ">"}, {"endian": "<", "delta": 200}):
+        _diff(_retrans_capture("nd-eh", **kw))

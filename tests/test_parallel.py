@@ -103,3 +103,13 @@ def test_engine_oversize_batch_pads_to_mesh_multiple():
     pws = _batch(16) + [b"extra-%02d" % i for i in range(4)]  # 20 candidates
     founds = eng.crack_batch(pws)
     assert [f.psk for f in founds] == [PSK]
+
+
+def test_multihost_mesh_single_process():
+    """Single-process degenerate case: spans all local devices; the
+    same dp axis the crack step shards over."""
+    from dwpa_tpu.parallel import multihost_mesh
+
+    mesh = multihost_mesh()
+    assert mesh.axis_names == ("dp",)
+    assert mesh.size == len(jax.devices())
