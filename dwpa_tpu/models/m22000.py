@@ -371,13 +371,14 @@ class M22000Engine:
         self.verify_with_oracle = verify_with_oracle
         self.groups = {}  # essid -> list[PreppedNet] (live/uncracked view)
         self.skipped = []
-        # Step traces bake the group's net constants in, so they are
-        # built once per ESSID group over its FULL original membership
-        # and never rebuilt: a find masks its net host-side in _collect
-        # instead of shrinking the traced shapes, which would otherwise
-        # recompile the whole step (~tens of seconds on TPU) per crack.
+        # Steps are built once per ESSID group over its FULL original
+        # membership and reused for the engine's lifetime: a find masks
+        # its net host-side in _collect instead of shrinking the step's
+        # shapes, which would move it to a different jit-cache entry.
+        # (Compilations themselves are shared process-wide by shape
+        # signature — parallel/step.py — so building a step is cheap.)
         self._full = {}   # essid -> original list[PreppedNet]
-        self._steps = {}  # essid -> jitted crack step
+        self._steps = {}  # essid -> crack step (parallel.build_crack_step)
         # Per-stage wall-clock accumulators (SURVEY.md §5.1): host pack +
         # H2D enqueue / device dispatch / sync + decode.  "collect" is
         # where device compute surfaces under the async runtime.
@@ -410,8 +411,8 @@ class M22000Engine:
             self._full.pop(found.line.essid, None)
 
     def _step_for(self, essid: bytes):
-        """The jitted mesh crack step for one ESSID group, traced once
-        over the group's full original membership (see __init__)."""
+        """The mesh crack step for one ESSID group, built once over the
+        group's full original membership (see __init__)."""
         from ..parallel import build_crack_step
 
         step = self._steps.get(essid)

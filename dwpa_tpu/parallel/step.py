@@ -1,7 +1,7 @@
 """The sharded full crack step: PBKDF2 -> verify, shard_map'd over the mesh.
 
-``build_crack_step`` closes over a prepped net list and returns one jitted
-function that runs the complete pipeline for a candidate batch:
+``build_crack_step`` returns one callable that runs the complete pipeline
+for a candidate batch:
 
 - the [B, 16] packed-password batch is split over the "dp" mesh axis;
 - each device runs PBKDF2(4096) + every net's MIC/PMKID check on its local
@@ -10,63 +10,250 @@ function that runs the complete pipeline for a candidate batch:
   by the host as a cheap "anything found?" gate before it pulls the
   (dp-sharded) per-net match matrix back for the rare positives.
 
+Compilation strategy (the part that matters operationally): a reference
+work unit is one ESSID group (all nets sharing the target's SSID,
+web/content/get_work.php:96-109), so a design that bakes the group's
+constants into the trace pays a full XLA compile (~tens of seconds on
+TPU) for every new work unit.  Here nothing net-specific is baked:
+
+- the PBKDF2 step takes the ESSID salt blocks as *data* — one compile
+  per batch size serves every ESSID ever cracked;
+- the verify steps take the nets' constants as stacked arrays and
+  ``vmap`` over the net axis, cached per shape signature
+  ``(kind, keyver, V variants, E eapol blocks)`` with the net count
+  padded up to a power-of-two bucket — a handful of compilations for a
+  server's whole lifetime, all shared across groups, engines and work
+  units.
+
 This is the TPU mapping of the reference's work distribution (volunteer
-data parallelism + ESSID-amortized PBKDF2, web/content/get_work.php:96-109)
-described in SURVEY.md §5.7.
+data parallelism + ESSID-amortized PBKDF2) described in SURVEY.md §5.7.
 """
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import m22000 as m
 from .mesh import DP_AXIS
 
+#: (mesh, kind, *static) -> jitted sharded step, shared process-wide.
+_STEP_CACHE = {}
+
+
+def _shard(mesh, fn, in_specs, out_specs):
+    # check_vma=False: the rolled compressions seed their fori_loop carries
+    # from unsharded per-net constants, which fails JAX's varying-manual-axes
+    # check even though every carry is elementwise over the dp-sharded batch
+    # (each device runs the identical replicated constants against its own
+    # candidate shard, so replication is trivially consistent).
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    )
+
+
+def pmk_step(mesh):
+    """jitted ``(pw_words[B,16], salt1[16], salt2[16]) -> pmk uint32[8, B]``.
+
+    Salts are data, so one compile per batch size serves every ESSID.
+    """
+    key = (mesh, "pmk")
+    if key not in _STEP_CACHE:
+        use_pallas = all(d.platform == "tpu" for d in mesh.devices.flat)
+
+        def local(pw_words, s1, s2):
+            return m._pmk_impl(pw_words, s1, s2, use_pallas=use_pallas)
+
+        _STEP_CACHE[key] = _shard(
+            mesh, local, (P(DP_AXIS, None), P(), P()), P(None, DP_AXIS)
+        )
+    return _STEP_CACHE[key]
+
+
+def _gate(found, mask):
+    """found bool[N, V, b], mask bool[N] -> replicated exact hit count.
+
+    The mask (data, so it never retriggers a trace) zeroes the bucket-pad
+    rows out of both the count and the returned matrix, keeping ``hits``
+    an exact match count and pad rows all-False for consumers.
+    """
+    found = found & mask[:, None, None]
+    return jax.lax.psum(jnp.sum(found, dtype=jnp.int32), DP_AXIS), found
+
+
+# One descriptor per verify code path — the single place that ties
+# together (a) the static trace parameters extracted from a net, (b) the
+# PreppedNet fields shipped to the device, and (c) the per-net match
+# function.  _partition, build_crack_step and verify_step all read this
+# table, so a new keyver is one new row, not three hand-synced switches.
+# Each match fn: (pmk[8,b], static tuple, *per-net consts) -> bool[V, b].
+_KINDS = {
+    "pmkid": (
+        lambda net: (),
+        ("pmkid_block", "target"),
+        lambda pmk, st, blk, tgt: m._pmkid_impl(pmk, blk, tgt)[None],
+    ),
+    "eapol": (
+        lambda net: (net.keyver,),
+        ("prf_blocks", "eapol_blocks", "target"),
+        lambda pmk, st, prf, eap, tgt: m.eapol_match(
+            pmk, prf, eap, tgt, keyver=st[0]
+        ),
+    ),
+    "cmac": (
+        lambda net: (bool(net.cmac_last_complete),),
+        ("prf_blocks", "cmac_full", "cmac_last", "cmac_target"),
+        lambda pmk, st, prf, full, last, tgt: m.eapol_cmac_match(
+            pmk, prf, full, last, tgt, last_complete=st[0]
+        ),
+    ),
+}
+
+
+def _kind_of(net) -> str:
+    if net.keyver == 100:
+        return "pmkid"
+    return "cmac" if net.keyver == 3 else "eapol"
+
+
+def verify_step(mesh, kind, static):
+    """jitted ``(pmk[8,B], mask[N], *stacked consts) -> (hits, found[N,V,B])``.
+
+    ``kind``/``static`` select the code path; array shapes (net-count
+    bucket, variant count, EAPOL blocks, batch) key jit's own cache.
+    """
+    key = (mesh, kind, static)
+    if key not in _STEP_CACHE:
+        _, fields, match = _KINDS[kind]
+
+        def local(pmk, mask, *consts):
+            fnd = jax.vmap(lambda *cs: match(pmk, static, *cs))(*consts)
+            return _gate(fnd, mask)
+
+        _STEP_CACHE[key] = _shard(
+            mesh,
+            local,
+            (P(None, DP_AXIS), P()) + (P(),) * len(fields),
+            (P(), P(None, None, DP_AXIS)),
+        )
+    return _STEP_CACHE[key]
+
+
+def _bucket(n: int) -> int:
+    """Pad net counts to powers of two so jit's shape cache hits across
+    groups of nearby sizes."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_nets(arrs):
+    """Stack per-net arrays and pad the net axis to its bucket by
+    repeating the last row.  Pad rows are dead weight whose hits the
+    verify step's mask (see ``_gate``) excludes from both the count and
+    the matrix; callers additionally slice found[:n]."""
+    stacked = np.stack(arrs)
+    pad = _bucket(len(arrs)) - len(arrs)
+    if pad:
+        stacked = np.concatenate([stacked, np.repeat(stacked[-1:], pad, axis=0)])
+    return stacked
+
+
+def _partition(nets):
+    """Group net indices by verify-step signature (kind, static params,
+    device-const shapes — everything that keys a distinct compilation)."""
+    parts = {}
+    for i, net in enumerate(nets):
+        kind = _kind_of(net)
+        statics, fields, _ = _KINDS[kind]
+        sig = (kind, statics(net),
+               tuple(getattr(net, f).shape for f in fields))
+        parts.setdefault(sig, []).append(i)
+    return parts
+
+
+def _assemble_step(mesh, struct, v_max, inv):
+    """jitted ``(*found parts) -> found[N, v_max, B]``: slice off bucket
+    padding, zero-pad variant axes, concatenate, restore input order.
+    Cached per part structure so the whole assembly stays one fused XLA
+    program instead of a chain of eager device ops per batch."""
+    key = (mesh, "asm", struct, v_max, None if inv is None else tuple(inv))
+    if key not in _STEP_CACHE:
+
+        def assemble(*fnds):
+            rows = []
+            for fnd, (n, v) in zip(fnds, struct):
+                fnd = fnd[:n]
+                if v < v_max:
+                    fnd = jnp.pad(fnd, ((0, 0), (0, v_max - v), (0, 0)))
+                rows.append(fnd)
+            found = rows[0] if len(rows) == 1 else jnp.concatenate(rows)
+            return found if inv is None else found[np.asarray(inv)]
+
+        _STEP_CACHE[key] = jax.jit(assemble)
+    return _STEP_CACHE[key]
+
 
 def build_crack_step(mesh, nets, salt1, salt2):
-    """Jit the full crack step for one ESSID group over ``mesh``.
+    """The full crack step for one ESSID group over ``mesh``.
 
-    ``nets``: list of PreppedNet sharing one ESSID (constants are folded
-    into the trace).  Returns ``step(pw_words[B,16]) -> (hits[], found,
-    pmk)`` where ``found`` is bool[N, V_max, B] (variant axes zero-padded
+    ``nets``: list of PreppedNet sharing one ESSID.  Returns
+    ``step(pw_words[B,16]) -> (hits, found, pmk)`` where ``found`` is
+    bool[N, V_max, B] in the order of ``nets`` (variant axes zero-padded
     so the per-net matrices stack) and ``pmk`` is uint32[8, B]; B must be
     divisible by the mesh size.  The host should gate on the replicated
     scalar ``hits`` and only fetch ``found``/``pmk`` for the rare
     positives (the psum hits-gate, SURVEY.md §5.7).
-    """
-    s1 = jnp.asarray(salt1)
-    s2 = jnp.asarray(salt2)
-    v_max = max(1 if n.keyver == 100 else len(n.variants) for n in nets)
-    use_pallas = all(d.platform == "tpu" for d in mesh.devices.flat)
 
-    def local_step(pw_words):
-        pmk = m._pmk_impl(pw_words, s1, s2, use_pallas=use_pallas)
-        per_net = []
-        for net in nets:
-            mv = m.net_match(pmk, net)  # [V, b]
-            pad = v_max - mv.shape[0]
-            if pad:
-                mv = jnp.concatenate(
-                    [mv, jnp.zeros((pad,) + mv.shape[1:], dtype=mv.dtype)]
-                )
-            per_net.append(mv)
-        found = jnp.stack(per_net)  # [N, V_max, b]
-        hits = jax.lax.psum(jnp.sum(found, dtype=jnp.int32), DP_AXIS)
+    Building a step never compiles anything group-specific: all jitted
+    pieces come from the process-wide shape-keyed cache above.
+    """
+    repl = NamedSharding(mesh, P())
+    s1 = jax.device_put(np.asarray(salt1), repl)
+    s2 = jax.device_put(np.asarray(salt2), repl)
+    v_max = max(1 if n.keyver == 100 else len(n.variants) for n in nets)
+    pmk_fn = pmk_step(mesh)
+
+    parts = []
+    order = []   # original net index per concatenated found row
+    struct = []  # (real net count, variant count) per part
+    for sig, idxs in _partition(nets).items():
+        kind, static = sig[0], sig[1]
+        _, fields, _ = _KINDS[kind]
+        group = [nets[i] for i in idxs]
+        mask = np.zeros(_bucket(len(group)), dtype=bool)
+        mask[: len(group)] = True
+        consts = (mask,) + tuple(
+            _pad_nets([getattr(g, f) for g in group]) for f in fields
+        )
+        consts = tuple(jax.device_put(c, repl) for c in consts)
+        parts.append((verify_step(mesh, kind, static), consts))
+        v = 1 if kind == "pmkid" else len(group[0].variants)
+        struct.append((len(group), v))
+        order.extend(idxs)
+    inv = np.argsort(np.asarray(order)) if order != sorted(order) else None
+    # Fast path: one part, no bucket padding, full variant width, input
+    # order — the verify step's output IS the final found matrix.
+    trivial = (
+        len(parts) == 1
+        and struct[0] == (len(nets), v_max)
+        and _bucket(len(nets)) == len(nets)
+        and inv is None
+    )
+    asm = None if trivial else _assemble_step(mesh, tuple(struct), v_max, inv)
+
+    def step(pw_words):
+        pmk = pmk_fn(pw_words, s1, s2)
+        hits = None
+        fnds = []
+        for fn, consts in parts:
+            h, fnd = fn(pmk, *consts)
+            hits = h if hits is None else hits + h
+            fnds.append(fnd)
+        found = fnds[0] if asm is None else asm(*fnds)
         return hits, found, pmk
 
-    # check_vma=False: the rolled compressions seed their fori_loop carries
-    # from unsharded per-net constants, which fails JAX's varying-manual-axes
-    # check even though every carry is elementwise over the dp-sharded batch
-    # (each device runs the identical closed-over constants against its own
-    # candidate shard, so replication is trivially consistent).
-    sharded = jax.shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(P(DP_AXIS, None),),
-        out_specs=(P(), P(None, None, DP_AXIS), P(None, DP_AXIS)),
-        check_vma=False,
-    )
-    return jax.jit(
-        sharded,
-        in_shardings=(NamedSharding(mesh, P(DP_AXIS, None)),),
-    )
+    return step

@@ -113,3 +113,38 @@ def test_multihost_mesh_single_process():
     mesh = multihost_mesh()
     assert mesh.axis_names == ("dp",)
     assert mesh.size == len(jax.devices())
+
+
+def test_crack_step_bucket_pad_and_reorder():
+    """3 same-signature EAPOL nets (bucket-padded to 4) interleaved with
+    a PMKID net: exercises the _pad_nets dup-row branch (hits must stay
+    an exact count) and the found-row order restoration (each found row
+    must belong to the net at that index of the input list)."""
+    mesh = default_mesh()
+    nets = [
+        m.prep_net(hl.parse(T.make_eapol_line(PSK, ESSID, keyver=2, seed="br1"))),
+        m.prep_net(hl.parse(T.make_pmkid_line(PSK, ESSID, seed="br2"))),
+        m.prep_net(hl.parse(T.make_eapol_line(PSK, ESSID, keyver=2, seed="br3"))),
+        m.prep_net(
+            hl.parse(
+                T.make_eapol_line(
+                    PSK, ESSID, keyver=2, nc_delta=2, endian="LE", seed="br4"
+                )
+            )
+        ),
+    ]
+    s1, s2 = m.essid_salt_blocks(ESSID)
+    step = build_crack_step(mesh, nets, s1, s2)
+    batch = 16
+    hits, found, _ = jax.block_until_ready(
+        step(shard_candidates(mesh, bo.pack_passwords_be(_batch(batch))))
+    )
+    assert int(hits) == 4  # exact: bucket-pad dup rows masked out
+    found = np.array(found)
+    assert found.shape[0] == 4
+    # every net matches exactly the planted column; the PMKID net's row
+    # (input index 1) must be the 1-variant row — order was restored
+    assert found[:, :, batch // 2].any(axis=1).all()
+    assert found[1, 0, batch // 2] and not found[1, 1:, :].any()
+    found[:, :, batch // 2] = False
+    assert not found.any()
