@@ -65,24 +65,61 @@ def cmd_serve(args):
         srv.serve_forever()
 
 
+def _geo_lookup_from_file(path):
+    """Offline geolocation source (a wigle CSV/JSON export): JSON object
+    ``{"aabbccddeeff": {"lat": .., "lon": .., "country": ..}, ...}``."""
+    with open(path) as f:
+        table = {k.lower(): v for k, v in json.load(f).items()}
+    return lambda mac: table.get(mac.hex())
+
+
+def _psk_lookup_from_file(path):
+    """Offline PSK-database source (a 3wifi-style dump): lines of
+    ``aabbccddeeff:psk``.  Answers still go through full server-side
+    re-verification — the file is never trusted."""
+    table = {}
+    with open(path, "rb") as f:
+        for ln in f:
+            mac, _, psk = ln.rstrip(b"\r\n").partition(b":")
+            if len(mac) == 12 and psk:
+                try:
+                    table[bytes.fromhex(mac.decode())] = psk
+                except (ValueError, UnicodeDecodeError):
+                    pass  # header/junk line, skip like any malformed row
+    return lambda macs: {m: table[m] for m in macs if m in table}
+
+
 def cmd_jobs(args):
-    """The cron layer: one shot of maintenance + keygen by default, or
-    continuous with --loop (maintenance hourly, keygen every 5 min — the
-    INSTALL.md:47-52 cadence)."""
-    from .jobs import keygen_precompute, maintenance
+    """The cron layer: one shot of maintenance + keygen (+ geolocation /
+    PSK lookup when a source is configured) by default, or continuous
+    with --loop (maintenance hourly, keygen every 5 min, enrichment every
+    10 min — the INSTALL.md:47-52 cadence)."""
+    from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
 
     core = _core(args)
+    geo = _geo_lookup_from_file(args.geo_file) if args.geo_file else None
+    psk = _psk_lookup_from_file(args.psk_file) if args.psk_file else None
     if not args.loop:
         out = {"maintenance": maintenance(core),
                "keygen": keygen_precompute(core)}
+        if geo:
+            out["geolocate"] = geolocate(core, geo)
+        if psk:
+            out["psk_lookup"] = psk_lookup(core, psk)
         print(json.dumps(out, default=str))
         return
-    last_maint = 0.0
+    last_maint = last_enrich = 0.0
     while True:
         now = time.time()
         if now - last_maint >= args.maint_interval:
             maintenance(core)
             last_maint = now
+        if (geo or psk) and now - last_enrich >= args.enrich_interval:
+            if geo:
+                geolocate(core, geo)
+            if psk:
+                psk_lookup(core, psk)
+            last_enrich = now
         keygen_precompute(core)
         time.sleep(args.keygen_interval)
 
@@ -125,6 +162,27 @@ def cmd_enrich(args):
         enrich_message_pair(_core(args), limit=args.limit, extractor=ex)))
 
 
+def cmd_migrate(args):
+    """Legacy hccapx / 16800-PMKID storage -> m22000 nets rows.
+
+    Input: a file of newline-separated legacy PMKID lines, a single
+    hccapx capture file (393-byte records back to back), or both.
+    """
+    from .tools import HCCAPX_LEN, migrate_legacy
+
+    records = []
+    for path in args.sources:
+        with open(path, "rb") as f:
+            blob = f.read()
+        if blob[:4] == b"HCPX":
+            records += [blob[i:i + HCCAPX_LEN]
+                        for i in range(0, len(blob), HCCAPX_LEN)]
+        else:
+            records += [ln for ln in blob.splitlines() if ln.strip()]
+    print(json.dumps(migrate_legacy(
+        _core(args), records, verify=not args.no_verify), default=str))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="dwpa_tpu.server")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -152,6 +210,13 @@ def main(argv=None):
     sp.add_argument("--loop", action="store_true")
     sp.add_argument("--maint-interval", type=float, default=3600)
     sp.add_argument("--keygen-interval", type=float, default=300)
+    sp.add_argument("--enrich-interval", type=float, default=600,
+                    help="geolocate/psk-lookup cadence (wigle.php/3wifi.php"
+                         " run every 10 min)")
+    sp.add_argument("--geo-file", help="offline geolocation JSON "
+                                       "{mac_hex: {lat, lon, country, ...}}")
+    sp.add_argument("--psk-file", help="offline PSK database, lines of "
+                                       "mac_hex:psk (3wifi-dump style)")
     sp.set_defaults(fn=cmd_jobs)
 
     sp = sub.add_parser("recrack", help="re-verify every cracked net")
@@ -186,6 +251,15 @@ def main(argv=None):
     sp.add_argument("--native", action="store_true",
                     help="use the C++ bulk parser (native/capture_fast)")
     sp.set_defaults(fn=cmd_enrich)
+
+    sp = sub.add_parser("migrate",
+                        help="convert legacy hccapx/16800 storage to m22000")
+    common(sp)
+    sp.add_argument("sources", nargs="+",
+                    help="hccapx file(s) and/or legacy PMKID line file(s)")
+    sp.add_argument("--no-verify", action="store_true",
+                    help="skip the post-migration recrack pass")
+    sp.set_defaults(fn=cmd_migrate)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -35,12 +35,6 @@ def maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     db = core.db
     day_ago = time.time() - 86400
 
-    # reap stale in-flight leases (vanished volunteers cost one lease window)
-    db.x(
-        "UPDATE n2d SET hkey = NULL WHERE hkey IS NOT NULL AND ts < ?",
-        (time.time() - LEASE_REAP_S,),
-    )
-
     s = {}
     s["nets"] = db.q1("SELECT COUNT(*) c FROM nets")["c"]
     s["cracked"] = db.q1("SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"]
@@ -83,6 +77,15 @@ def maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     )["c"]
     for name, value in s.items():
         db.set_stat(name, value)
+
+    # Reap stale in-flight leases AFTER the stats pass, matching the
+    # reference's ordering (maint.php computes its counters at 16-32 and
+    # reaps at 36) — reaping first would drop just-expired work units out
+    # of 24getwork/contributors for the hour they should still count.
+    db.x(
+        "UPDATE n2d SET hkey = NULL WHERE hkey IS NOT NULL AND ts < ?",
+        (time.time() - LEASE_REAP_S,),
+    )
 
     if cracked_dict_path:
         regen_cracked_dict(core, cracked_dict_path)

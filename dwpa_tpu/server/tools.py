@@ -230,3 +230,67 @@ def enrich_message_pair(core: ServerCore, limit: int = None,
                 )
                 updated += 1
     return {"updated": updated}
+
+
+# ---------------------------------------------------------------------------
+# Legacy-storage migration (misc/migrate_to_m22000.php)
+# ---------------------------------------------------------------------------
+
+HCCAPX_LEN = 393  # fixed struct size (hashcat hccapx v4 format)
+
+
+def convert_legacy(record) -> str:
+    """One legacy stored net -> m22000 hashline string, or None.
+
+    The two pre-m22000 storage forms the reference migrates
+    (misc/migrate_to_m22000.php:253-270):
+
+    - a 393-byte hccapx struct ("HCPX" magic): repacked into a TYPE-02
+      EAPOL hashline carrying the struct's message_pair verbatim;
+    - a legacy PMKID line ``pmkid:mac_ap:mac_sta:essid_hex`` (the
+      hcxtools 16800 format): rewritten as a TYPE-01 line with empty
+      anonce/eapol/message_pair fields.
+    """
+    if isinstance(record, str):
+        record = record.encode()
+    if len(record) == HCCAPX_LEN and record[:4] == b"HCPX":
+        mp, essid_len = record[8], record[9]
+        essid = record[10 : 10 + min(essid_len, 32)]
+        keymic = record[43:59]
+        mac_ap = record[59:65]
+        nonce_ap = record[65:97]
+        mac_sta = record[97:103]
+        eapol_len = int.from_bytes(record[135:137], "little")
+        eapol = record[137 : 137 + min(eapol_len, 256)]
+        return "WPA*02*%s*%s*%s*%s*%s*%s*%02x" % (
+            keymic.hex(), mac_ap.hex(), mac_sta.hex(), essid.hex(),
+            nonce_ap.hex(), eapol.hex(), mp,
+        )
+    parts = record.strip().decode("ascii", "replace").split(":")
+    if len(parts) == 4 and all(parts):
+        return "WPA*01*%s*%s*%s*%s***" % tuple(p.lower() for p in parts)
+    return None
+
+
+def migrate_legacy(core: ServerCore, records, ip: str = "",
+                   verify: bool = True) -> dict:
+    """Convert legacy records and ingest them through the normal pipeline.
+
+    Mirrors the reference's migration posture: every record goes through
+    ``convert_legacy`` then ``add_hashlines`` (hash-identity dedup, zero-
+    PMK probe, cross-crack — the same checks fresh captures get), and
+    with ``verify`` the migrated DB must pass ``recrack_verify`` before
+    the function returns (migrate_to_m22000.php:121-141 aborts the whole
+    migration on one recrack failure).
+    """
+    lines, bad = [], 0
+    for rec in records:
+        line = convert_legacy(rec)
+        if line is None:
+            bad += 1
+        else:
+            lines.append(line)
+    res = core.add_hashlines(lines, ip=ip)
+    if verify:
+        recrack_verify(core)
+    return {"converted": len(lines), "unconvertible": bad, **res}

@@ -227,3 +227,117 @@ def test_cli_conf_file(tmp_path, capsys):
 def test_cli_requires_db_or_conf(tmp_path):
     with pytest.raises(SystemExit):
         cli_main(["recrack"])
+
+
+# ---------------------------------------------------------------------------
+# legacy-storage migration (misc/migrate_to_m22000.php:253-270)
+
+
+def _hccapx_from_line(line: str) -> bytes:
+    """Pack a parsed m22000 EAPOL line back into a 393-byte hccapx record
+    (the hashcat v4 struct the reference migrates FROM)."""
+    from dwpa_tpu.models import hashline as hl
+
+    h = hl.parse(line)
+    keyver = h.keyver
+    rec = bytearray(393)
+    rec[0:4] = b"HCPX"
+    rec[4:8] = (4).to_bytes(4, "little")       # version
+    rec[8] = h.message_pair or 0
+    rec[9] = len(h.essid)
+    rec[10:10 + len(h.essid)] = h.essid
+    rec[42] = keyver
+    rec[43:59] = h.pmkid_or_mic
+    rec[59:65] = h.mac_ap
+    rec[65:97] = h.anonce
+    rec[97:103] = h.mac_sta
+    rec[103:135] = h.eapol[17:49]              # snonce from the EAPOL body
+    rec[135:137] = len(h.eapol).to_bytes(2, "little")
+    rec[137:137 + len(h.eapol)] = h.eapol
+    return bytes(rec)
+
+
+def test_convert_legacy_hccapx_roundtrips_crackable(core):
+    src = tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="mig1")
+    line = tools.convert_legacy(_hccapx_from_line(src))
+    from dwpa_tpu.models import hashline as hl
+    from dwpa_tpu.oracle import m22000 as oracle
+
+    h = hl.parse(line)
+    assert h.essid == ESSID and h.hash_type == hl.TYPE_EAPOL
+    assert oracle.check_key_m22000(h, [PSK]) is not None
+
+
+def test_convert_legacy_pmkid_line(core):
+    src = tfx.make_pmkid_line(PSK, ESSID, seed="mig2")
+    p = src.split("*")
+    legacy = ":".join([p[2], p[3], p[4], p[5]])
+    line = tools.convert_legacy(legacy)
+    from dwpa_tpu.models import hashline as hl
+    from dwpa_tpu.oracle import m22000 as oracle
+
+    h = hl.parse(line)
+    assert h.hash_type == hl.TYPE_PMKID
+    assert oracle.check_key_m22000(h, [PSK]) is not None
+
+
+def test_convert_legacy_rejects_junk():
+    assert tools.convert_legacy(b"not a record") is None
+    assert tools.convert_legacy(b"a:b") is None
+
+
+def test_migrate_legacy_ingests_and_recracks(core):
+    eap = tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="mig3")
+    pmk = tfx.make_pmkid_line(PSK, ESSID, seed="mig4")
+    p = pmk.split("*")
+    records = [
+        _hccapx_from_line(eap),
+        ":".join([p[2], p[3], p[4], p[5]]).encode(),
+        b"garbage line",
+    ]
+    res = tools.migrate_legacy(core, records)
+    assert res["converted"] == 2 and res["unconvertible"] == 1
+    assert res["new"] == 2
+    assert core.db.q1("SELECT COUNT(*) c FROM nets")["c"] == 2
+    # migrated nets crack through the normal acceptance path
+    for net in core.db.q("SELECT * FROM nets"):
+        assert core.put_work(
+            {"hkey": "0" * 32,
+             "cand": [{"k": net["struct"].split("*")[3], "v": PSK.hex()}]}
+        )
+    tools.recrack_verify(core)
+
+
+def test_cli_migrate(tmp_path, capsys):
+    dbp = str(tmp_path / "m.db")
+    eap = tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="mig5")
+    hx = tmp_path / "old.hccapx"
+    hx.write_bytes(_hccapx_from_line(eap))
+    cli_main(["migrate", "--db", dbp, str(hx)])
+    out = json.loads(capsys.readouterr().out)
+    assert out["new"] == 1
+
+
+def test_cli_jobs_with_offline_lookups(tmp_path, capsys):
+    dbp = str(tmp_path / "j.db")
+    db = Database(dbp)
+    core2 = ServerCore(db)
+    line = tfx.make_pmkid_line(PSK, ESSID, seed="jl1")
+    core2.add_hashlines([line])
+    mac = line.split("*")[3]
+    (tmp_path / "geo.json").write_text(
+        json.dumps({mac: {"lat": 1.5, "lon": 2.5, "country": "BG"}})
+    )
+    (tmp_path / "psk.txt").write_bytes(b"%s:%s\n" % (mac.encode(), PSK))
+    db.close()
+    cli_main(["jobs", "--db", dbp,
+              "--geo-file", str(tmp_path / "geo.json"),
+              "--psk-file", str(tmp_path / "psk.txt")])
+    out = json.loads(capsys.readouterr().out)
+    assert out["geolocate"] == 1
+    assert out["psk_lookup"]["submitted"] == 1
+    db = Database(dbp)
+    net = db.q1("SELECT n_state, pass FROM nets")
+    assert net["n_state"] == 1 and net["pass"] == PSK
+    geo = db.q1("SELECT lat, country FROM bssids")
+    assert geo["lat"] == 1.5 and geo["country"] == "BG"
