@@ -62,6 +62,11 @@ class ClientConfig:
     nc: int = 8
     max_work_units: int = 0         # 0 = run forever
     pace_target: float = PACE_TARGET_S
+    cracked_refresh: int = 100      # re-download cracked/rkg dicts every
+                                    # N work units (DAW dl_count cadence,
+                                    # help_crack.py:47,524-529)
+    archive: bool = True            # append-only archive.22000/archive.res
+                                    # audit logs (DAW, help_crack.py:453-456)
 
 
 @dataclass
@@ -84,6 +89,10 @@ class TpuCrackClient:
         self.resume_path = os.path.join(config.workdir, "resume.json")
         self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
         self.dictcount = max(1, min(15, config.dictcount))
+        # cracked/rkg refresh countdown: primed to refresh on first use,
+        # then every cfg.cracked_refresh units (DAW dl_count semantics).
+        self._cracked_countdown = 0
+        self._resuming = False
 
     # -- self-update (help_crack.py:158-189) --------------------------------
 
@@ -162,14 +171,62 @@ class TpuCrackClient:
         return None
 
     def _fetch_dicts(self, work: dict) -> list:
-        """Download (or reuse cached) work dicts; returns local paths."""
+        """Download (or reuse cached) pass-2 work dicts; returns local
+        paths.  cracked.txt.gz is excluded — it runs in pass 1 via
+        ``_cracked_candidates`` (the DAW client likewise removes it from
+        the rules pass, help_crack.py:927-928)."""
         paths = []
         for d in work.get("dicts", []):
+            if os.path.basename(d["dpath"]) == "cracked.txt.gz":
+                continue
             dest = os.path.join(self.dictdir, d["dhash"] + ".gz")
             if not os.path.exists(dest):
                 self.api.download(d["dpath"], dest, expected_md5=d["dhash"])
             paths.append(dest)
         return paths
+
+    def _cracked_candidates(self, work: dict, rules):
+        """Pass-1 stream of the server's cracked + rkg dictionaries,
+        expanded through the work rules.
+
+        DAW behavior (help_crack.py:469-509,512-529): when a work unit
+        carries cracked.txt.gz, keep a local copy refreshed only every
+        ``cracked_refresh`` units, fetch rkg.txt.gz alongside it
+        (best-effort — stock servers serve it as a plain artifact), and
+        run both through the rule set before everything else: previously
+        cracked and vendor-default keys are the highest-yield candidates.
+        """
+        entry = next(
+            (d for d in work.get("dicts", [])
+             if os.path.basename(d["dpath"]) == "cracked.txt.gz"),
+            None,
+        )
+        if entry is None:
+            return
+        cracked = os.path.join(self.dictdir, "cracked.txt.gz")
+        rkg = os.path.join(self.dictdir, "rkg.txt.gz")
+        # The cadence refresh is suppressed while replaying a resumed
+        # unit (the skip-by-count fast-forward needs the same bytes the
+        # crashed run streamed), but a *missing* file is always fetched —
+        # yielding nothing would submit the unit with its highest-yield
+        # candidates never tried.
+        cadence = self._cracked_countdown <= 0 and not self._resuming
+        if cadence or not os.path.exists(cracked):
+            try:
+                self.api.download(entry["dpath"], cracked, max_tries=2,
+                                  expected_md5=entry.get("dhash"))
+                self._cracked_countdown = self.cfg.cracked_refresh
+            except (ConnectionError, ValueError, OSError):
+                pass
+            try:
+                self.api.download("dict/rkg.txt.gz", rkg, max_tries=1)
+            except (ConnectionError, ValueError, OSError):
+                pass
+        self._cracked_countdown -= 1
+        for path in (cracked, rkg):
+            if os.path.exists(path):
+                stream = DictStream(path)
+                yield from (apply_rules(rules, stream) if rules else stream)
 
     def _rules(self, work: dict):
         blob = work.get("rules")
@@ -215,15 +272,31 @@ class TpuCrackClient:
             for fd in founds:
                 f.write(f"{fd.line.raw}:{fd.psk.decode('latin1')}\n")
 
+    def _archive_work(self, work: dict):
+        """Append-only audit logs (DAW fork, help_crack.py:453-456,
+        741-743): every work unit's hashlines land in archive.22000 and
+        its resume snapshot in archive.res, so an operator can replay or
+        post-mortem any unit the client ever handled."""
+        if not self.cfg.archive:
+            return
+        with open(os.path.join(self.cfg.workdir, "archive.22000"), "a") as f:
+            for line in work.get("hashes", []):
+                f.write(line + "\n")
+        with open(os.path.join(self.cfg.workdir, "archive.res"), "a") as f:
+            f.write(json.dumps({k: v for k, v in work.items()
+                                if not k.startswith("_")}) + "\n")
+
     # -- the loop ----------------------------------------------------------
 
     def _all_candidates(self, engine: M22000Engine, work: dict):
         """The full deterministic candidate stream for one work unit:
-        pass 1 (targeted, no rules) then pass 2 (server dicts through
-        server rules).  Dict downloads happen lazily when the stream
-        reaches them, so a resume skipping pass 1 still fetches dicts."""
+        pass 1 (targeted generators, then cracked/rkg through rules) and
+        pass 2 (remaining server dicts through server rules).  Dict
+        downloads happen lazily when the stream reaches them, so a
+        resume skipping pass 1 still fetches dicts."""
         yield from self._targeted_candidates(engine, work)
         rules = self._rules(work)
+        yield from self._cracked_candidates(work, rules)
         for path in self._fetch_dicts(work):
             stream = DictStream(path)
             yield from (apply_rules(rules, stream) if rules else stream)
@@ -240,6 +313,10 @@ class TpuCrackClient:
         self._write_resume(work)
         progress = work.pop("_progress", None) or {}
         skip = int(progress.get("done", 0))
+        self._resuming = skip > 0
+        if not self._resuming:
+            # once per unit: a resume replay must not duplicate the entry
+            self._archive_work(work)
         prior_cand = list(progress.get("cand", []))
         engine = M22000Engine(
             work["hashes"], nc=self.cfg.nc, batch_size=self.cfg.batch_size

@@ -34,6 +34,8 @@ def maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     """Stats + lease reaping + cracked-dict regen; returns the stats."""
     db = core.db
     day_ago = time.time() - 86400
+    if cracked_dict_path is None and core.dictdir:
+        cracked_dict_path = os.path.join(core.dictdir, "cracked.txt.gz")
 
     s = {}
     s["nets"] = db.q1("SELECT COUNT(*) c FROM nets")["c"]
@@ -130,6 +132,30 @@ def regen_cracked_dict(core: ServerCore, path: str) -> int:
     return len(words)
 
 
+def regen_rkg_dict(core: ServerCore, path: str) -> int:
+    """rkg.txt.gz: distinct passwords of keygen-cracked nets (algo set
+    and non-empty — rkg.php:178-197 regenerates this dict on any keygen
+    hit so volunteers try known vendor-default keys everywhere).
+
+    Served as a plain ``/dict/`` artifact, NOT registered in the dicts
+    table — exactly the reference's arrangement: clients fetch it in
+    their cracked/rkg pass 1, and registering it would double-issue the
+    same words through the scheduler.  ORDER BY keeps the bytes (and so
+    any cached copy) stable when the word set hasn't changed.
+    """
+    rows = core.db.q(
+        """SELECT DISTINCT pass FROM nets
+           WHERE algo IS NOT NULL AND algo != '' AND pass IS NOT NULL
+           ORDER BY pass"""
+    )
+    words = [r["pass"] for r in rows]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        with gzip.GzipFile(fileobj=f, mode="wb", compresslevel=9, mtime=0) as gz:
+            gz.write(b"\n".join(words) + (b"\n" if words else b""))
+    return len(words)
+
+
 def single_mode_candidates(bssid: bytes, ssid: bytes):
     """The "Single" generator: bssid +/-1 in 12/10/8-hex widths and ssid
     case/suffix mutations (rkg.php single_mode_generator, :48-77)."""
@@ -196,6 +222,10 @@ def keygen_precompute(core: ServerCore, limit: int = 100,
             "UPDATE nets SET algo = ? WHERE net_id = ?",
             (hit_algo, net["net_id"]),
         )
+    if found and core.dictdir:
+        # any keygen hit regenerates the vendor-key dictionary so every
+        # volunteer tries known default keys everywhere (rkg.php:178-197)
+        regen_rkg_dict(core, os.path.join(core.dictdir, "rkg.txt.gz"))
     return {"processed": len(nets), "cracked": found}
 
 

@@ -275,3 +275,61 @@ def test_resume_skips_done_and_resubmits_founds(server, tmp_path):
     assert res.accepted
     row = server.db.q1("SELECT n_state, pass FROM nets")
     assert row["n_state"] == 1 and row["pass"] == PSK
+
+
+def test_cracked_dict_runs_in_pass1_with_rkg(server, tmp_path):
+    """A work unit carrying cracked.txt.gz: the client streams it (plus
+    the server's rkg.txt.gz) through the work rules in pass 1 and cracks
+    a net whose PSK only appears in the rkg dictionary
+    (help_crack.py:469-509)."""
+    from dwpa_tpu.server.jobs import regen_rkg_dict
+
+    _ingest(server, [tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="cd1")])
+    # the vendor-key dict holds the PSK; the cracked dict holds chaff
+    server.add_hashlines([tfx.make_pmkid_line(PSK, b"OtherNet", seed="cd1v")])
+    server.db.x(
+        "UPDATE nets SET algo = 'Vendor', n_state = 1, pass = ? "
+        "WHERE ssid = ?", (PSK, b"OtherNet"))
+    regen_rkg_dict(server, os.path.join(server.dictdir, "rkg.txt.gz"))
+    _add_dict(server, [b"chaff-00001", b"chaff-00002"], name="cracked.txt.gz")
+
+    client = _client(server, tmp_path)
+    work = client.api.get_work(client.dictcount)
+    assert any("cracked.txt.gz" in d["dpath"] for d in work["dicts"])
+    res = client.process_work(work)
+    assert [f.psk for f in res.founds] == [PSK]
+    assert server.db.q1(
+        "SELECT n_state FROM nets WHERE ssid = ?", (ESSID,))["n_state"] == 1
+
+
+def test_cracked_dict_refresh_cadence(server, tmp_path):
+    """cracked.txt.gz is re-downloaded only every cracked_refresh units
+    (DAW dl_count, help_crack.py:524-529)."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="cd2")])
+    _add_dict(server, [PSK], name="cracked.txt.gz")
+    client = _client(server, tmp_path, cracked_refresh=3)
+    work = client.api.get_work(client.dictcount)
+
+    def dl_count():
+        return sum(1 for m, u in client.api.requests if "cracked.txt.gz" in u)
+
+    list(client._cracked_candidates(dict(work), []))  # first use: downloads
+    assert dl_count() == 1
+    list(client._cracked_candidates(dict(work), []))  # countdown=2: cached
+    list(client._cracked_candidates(dict(work), []))  # countdown=1: cached
+    assert dl_count() == 1
+    list(client._cracked_candidates(dict(work), []))  # countdown=0: refresh
+    assert dl_count() == 2
+
+
+def test_archive_logs_appended(server, tmp_path):
+    """archive.22000 / archive.res audit logs accumulate one entry per
+    unit (DAW, help_crack.py:453-456,741-743)."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="ar1")])
+    _add_dict(server, [PSK])
+    client = _client(server, tmp_path, max_work_units=1)
+    assert client.run() == 1
+    arc = open(os.path.join(client.cfg.workdir, "archive.22000")).read()
+    assert arc.count("WPA*") >= 1
+    res_lines = open(os.path.join(client.cfg.workdir, "archive.res")).read()
+    assert json.loads(res_lines.splitlines()[-1])["hkey"]
