@@ -134,7 +134,11 @@ def cmd_pack_dict(args):
     from .tools import pack_dict
 
     rules = None
-    if args.rules:
+    if args.default_rules:
+        from ..rules import wpa_rules_text
+
+        rules = wpa_rules_text()
+    elif args.rules:
         with open(args.rules) as f:
             rules = f.read()
     print(json.dumps(pack_dict(_core(args), args.source, args.name, rules=rules)))
@@ -229,6 +233,8 @@ def main(argv=None):
     sp.add_argument("source", help="input wordlist (.txt or .txt.gz)")
     sp.add_argument("--name", required=True, help="served dict name")
     sp.add_argument("--rules", help="hashcat rules file to attach")
+    sp.add_argument("--default-rules", action="store_true",
+                    help="attach the bundled WPA ruleset (rules/wpa.rule)")
     sp.set_defaults(fn=cmd_pack_dict)
 
     sp = sub.add_parser("dedup-dicts", help="cross-dict dedup, earlier wins")

@@ -333,3 +333,26 @@ def test_archive_logs_appended(server, tmp_path):
     assert arc.count("WPA*") >= 1
     res_lines = open(os.path.join(client.cfg.workdir, "archive.res")).read()
     assert json.loads(res_lines.splitlines()[-1])["hkey"]
+
+
+def test_bundled_wpa_rules_crack_mangled_psk(server, tmp_path):
+    """A dict packed with the bundled WPA ruleset cracks a PSK that is a
+    base word through a rule ('c $1'), end-to-end over the wire — the
+    bestWPA.rule distribution flow (get_work.php:84-92)."""
+    from dwpa_tpu.rules import wpa_rules_text
+
+    mangled = b"Loopword9!1"  # 'loopword9!' through 'c $1'
+    _ingest(server, [tfx.make_pmkid_line(mangled, ESSID, seed="wr1")])
+    os.makedirs(server.dictdir, exist_ok=True)
+    blob = gzip.compress(b"loopword9!\n")
+    path = os.path.join(server.dictdir, "wr.txt.gz")
+    open(path, "wb").write(blob)
+    server.add_dict("dict/wr.txt.gz", "wr.txt.gz",
+                    hashlib.md5(blob).hexdigest(), 1,
+                    rules=wpa_rules_text())
+    client = _client(server, tmp_path)
+    work = client.api.get_work(1)
+    assert work.get("rules")  # merged + base64'd into the unit
+    res = client.process_work(work)
+    assert [f.psk for f in res.founds] == [mangled]
+    assert server.db.q1("SELECT n_state FROM nets")["n_state"] == 1

@@ -174,3 +174,28 @@ def test_dictstream_sniffs_gzip_bytesio():
     buf = io.BytesIO(gzip.compress(b"one\ntwo\n"))
     assert list(DictStream(buf)) == [b"one", b"two"]
     assert list(DictStream(buf)) == [b"one", b"two"]
+
+
+# ---------------------------------------------------------------------------
+# the bundled WPA ruleset (the bestWPA.rule asset equivalent)
+
+
+def test_wpa_rule_asset_fully_parses():
+    from dwpa_tpu.rules import WPA_RULE_PATH, parse_rules, wpa_rules
+
+    with open(WPA_RULE_PATH) as f:
+        lines = [ln for ln in f.read().splitlines()
+                 if ln.strip() and not ln.lstrip().startswith("#")]
+    rules = parse_rules(lines, on_error="raise")  # every line must parse
+    assert len(rules) == len(lines) == len(wpa_rules())
+    assert len(rules) >= 100  # a real ruleset, not a stub
+
+
+def test_wpa_rules_expand_expected_shapes():
+    from dwpa_tpu.rules import apply_rules, wpa_rules
+
+    out = set(apply_rules(wpa_rules(), [b"password"]))
+    for expect in (b"password", b"Password", b"PASSWORD", b"password1",
+                   b"password123", b"password2024", b"p@ssword",
+                   b"passw0rd", b"drowssap", b"passwordpassword"):
+        assert expect in out, expect
