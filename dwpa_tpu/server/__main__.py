@@ -166,6 +166,16 @@ def cmd_enrich(args):
         enrich_message_pair(_core(args), limit=args.limit, extractor=ex)))
 
 
+def cmd_pack_client(args):
+    from .tools import pack_client
+
+    _load_conf(args)
+    if not args.hcdir:
+        raise SystemExit("--hcdir (or a conf file with an 'hcdir' key) "
+                         "is required")
+    print(json.dumps(pack_client(args.hcdir, version=args.version)))
+
+
 def cmd_migrate(args):
     """Legacy hccapx / 16800-PMKID storage -> m22000 nets rows.
 
@@ -257,6 +267,14 @@ def main(argv=None):
     sp.add_argument("--native", action="store_true",
                     help="use the C++ bulk parser (native/capture_fast)")
     sp.set_defaults(fn=cmd_enrich)
+
+    sp = sub.add_parser("pack-client",
+                        help="build the hc/ self-update artifacts "
+                             "(dwpa_tpu.pyz + version manifest)")
+    sp.add_argument("--conf", help="JSON conf file (supplies hcdir)")
+    sp.add_argument("--hcdir", help="output dir served at /hc/")
+    sp.add_argument("--version", help="override the advertised version")
+    sp.set_defaults(fn=cmd_pack_client)
 
     sp = sub.add_parser("migrate",
                         help="convert legacy hccapx/16800 storage to m22000")

@@ -294,3 +294,64 @@ def migrate_legacy(core: ServerCore, records, ip: str = "",
     if verify:
         recrack_verify(core)
     return {"converted": len(lines), "unconvertible": bad, **res}
+
+
+# ---------------------------------------------------------------------------
+# Client distribution (the web/hc/ artifact dir, help_crack.py:158-189)
+# ---------------------------------------------------------------------------
+
+
+def pack_client(hcdir: str, version: str = None) -> dict:
+    """Build the self-update artifacts: ``dwpa_tpu.pyz`` + version manifest.
+
+    The reference serves ``hc/help_crack.py`` with a one-line
+    ``help_crack.py.version`` next to it; here the client is a package,
+    so the artifact is a zipapp (runnable as ``python dwpa_tpu.pyz
+    <server-url>``) and the manifest carries ``<version> <archive-md5>``
+    so the client can integrity-check the download
+    (client/main.py:check_update).
+    """
+    import re
+    import zipfile
+
+    import dwpa_tpu
+
+    version = version or dwpa_tpu.__version__
+    # The client's manifest probe only accepts this shape
+    # (client/main.py:check_update) — publishing anything else would
+    # silently disable updates fleet-wide.
+    if not re.fullmatch(r"[0-9]+(\.[0-9]+)*[a-z0-9]*", version):
+        raise ValueError(f"version {version!r} would be rejected by the "
+                         "client's manifest check")
+    pkg_root = os.path.dirname(os.path.abspath(dwpa_tpu.__file__))
+    os.makedirs(hcdir, exist_ok=True)
+    pyz = os.path.join(hcdir, "dwpa_tpu.pyz")
+    count = 0
+    with zipfile.ZipFile(pyz, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(pkg_root):
+            # sorted: readdir order varies per filesystem, and the md5
+            # must be reproducible across hosts serving the same tree
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for name in sorted(files):
+                if name.endswith((".pyc", ".so")):
+                    continue  # native libs rebuild from the bundled source
+                full = os.path.join(root, name)
+                rel = "dwpa_tpu/" + os.path.relpath(full, pkg_root).replace(
+                    os.sep, "/"
+                )  # zipimport requires forward slashes
+                # Deterministic archive: fixed timestamp so the md5 (and
+                # every client's cached copy) moves only with content.
+                info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+                info.compress_type = zipfile.ZIP_DEFLATED
+                with open(full, "rb") as f:
+                    z.writestr(info, f.read())
+                count += 1
+        stub = ("from dwpa_tpu.client.__main__ import main\n"
+                "main()\n")
+        info = zipfile.ZipInfo("__main__.py", date_time=(1980, 1, 1, 0, 0, 0))
+        z.writestr(info, stub)
+    with open(pyz, "rb") as f:
+        md5 = hashlib.md5(f.read()).hexdigest()
+    with open(os.path.join(hcdir, "dwpa_tpu.version"), "w") as f:
+        f.write(f"{version} {md5}\n")
+    return {"pyz": pyz, "version": version, "md5": md5, "files": count}

@@ -341,3 +341,84 @@ def test_cli_jobs_with_offline_lookups(tmp_path, capsys):
     assert net["n_state"] == 1 and net["pass"] == PSK
     geo = db.q1("SELECT lat, country FROM bssids")
     assert geo["lat"] == 1.5 and geo["country"] == "BG"
+
+
+# ---------------------------------------------------------------------------
+# client distribution artifacts (web/hc/, help_crack.py:158-189)
+
+
+def test_pack_client_builds_runnable_zipapp(tmp_path):
+    import subprocess
+    import sys
+
+    out = tools.pack_client(str(tmp_path / "hc"))
+    assert out["files"] > 20
+    manifest = (tmp_path / "hc" / "dwpa_tpu.version").read_text().split()
+    assert manifest[0] == out["version"] and manifest[1] == out["md5"]
+    assert hashlib.md5((tmp_path / "hc" / "dwpa_tpu.pyz").read_bytes()
+                       ).hexdigest() == out["md5"]
+    # the archive actually runs as a client entry point
+    r = subprocess.run(
+        [sys.executable, str(tmp_path / "hc" / "dwpa_tpu.pyz"), "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0 and "dwpa" in r.stdout
+
+
+def test_pack_client_deterministic(tmp_path):
+    a = tools.pack_client(str(tmp_path / "a"))
+    b = tools.pack_client(str(tmp_path / "b"))
+    assert a["md5"] == b["md5"]
+
+
+def test_update_flow_against_packed_client(tmp_path):
+    """End-to-end self-update probe: a server with an hcdir serving a
+    NEWER packed client makes check_update download + verify it."""
+    import io
+    import json as _json
+    import urllib.parse
+
+    from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+    from dwpa_tpu.client.protocol import ServerAPI
+    from dwpa_tpu.server.api import make_wsgi_app
+
+    hcdir = str(tmp_path / "hc")
+    out = tools.pack_client(hcdir, version="999.0.0")
+    db = Database(":memory:")
+    core2 = ServerCore(db, hcdir=hcdir)
+    app = make_wsgi_app(core2)
+
+    class API(ServerAPI):
+        def fetch(self, url, data=None, max_tries=None):
+            parsed = urllib.parse.urlparse(url)
+            env = {"REQUEST_METHOD": "GET", "PATH_INFO": parsed.path or "/",
+                   "QUERY_STRING": parsed.query, "CONTENT_LENGTH": "0",
+                   "wsgi.input": io.BytesIO(b""), "REMOTE_ADDR": "1.2.3.4"}
+            st = {}
+            body = b"".join(app(env, lambda s, h: st.update(status=s)))
+            if not st["status"].startswith("200"):
+                raise ConnectionError(st["status"])
+            return body
+
+        def remote_version(self):
+            return self.fetch("http://x/hc/dwpa_tpu.version").decode().strip()
+
+    cfg = ClientConfig(base_url="http://x/", workdir=str(tmp_path / "w"))
+    client = TpuCrackClient(cfg, api=API("http://x/"), log=lambda *a: None)
+    assert client.check_update()
+    pyz = os.path.join(cfg.workdir, "dwpa_tpu-999.0.0.pyz")
+    assert hashlib.md5(open(pyz, "rb").read()).hexdigest() == out["md5"]
+
+
+def test_pack_client_rejects_bad_version(tmp_path):
+    with pytest.raises(ValueError, match="rejected"):
+        tools.pack_client(str(tmp_path / "hc"), version="v2.0-rc1")
+
+
+def test_cli_pack_client_reads_conf(tmp_path, capsys):
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({"hcdir": str(tmp_path / "hc")}))
+    cli_main(["pack-client", "--conf", str(conf)])
+    out = json.loads(capsys.readouterr().out)
+    assert os.path.isfile(tmp_path / "hc" / "dwpa_tpu.version")
+    assert out["files"] > 20
