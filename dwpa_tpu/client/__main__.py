@@ -25,6 +25,9 @@ def build_parser():
     p.add_argument("--nc", type=int, default=8,
                    help="nonce-error-correction budget (reference -co "
                         "--nonce-error-corrections, help_crack.py:773)")
+    p.add_argument("--rule-workers", type=int, default=0,
+                   help="expand rules in N worker processes (feeds a "
+                        "multi-chip mesh; 0 = inline)")
     return p
 
 
@@ -39,6 +42,7 @@ def main(argv=None):
         potfile=args.potfile,
         max_work_units=args.max_work_units,
         nc=args.nc,
+        rule_workers=args.rule_workers,
     )
     TpuCrackClient(cfg).run()
 

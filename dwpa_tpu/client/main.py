@@ -65,6 +65,8 @@ class ClientConfig:
     cracked_refresh: int = 100      # re-download cracked/rkg dicts every
                                     # N work units (DAW dl_count cadence,
                                     # help_crack.py:47,524-529)
+    rule_workers: int = 0           # >1: expand rules in a process pool
+                                    # (feeds a multi-chip mesh; 0 = inline)
     archive: bool = True            # append-only archive.22000/archive.res
                                     # audit logs (DAW, help_crack.py:453-456)
 
@@ -226,7 +228,8 @@ class TpuCrackClient:
         for path in (cracked, rkg):
             if os.path.exists(path):
                 stream = DictStream(path)
-                yield from (apply_rules(rules, stream) if rules else stream)
+                yield from (apply_rules(rules, stream, workers=self.cfg.rule_workers)
+                        if rules else stream)
 
     def _rules(self, work: dict):
         blob = work.get("rules")
@@ -299,7 +302,8 @@ class TpuCrackClient:
         yield from self._cracked_candidates(work, rules)
         for path in self._fetch_dicts(work):
             stream = DictStream(path)
-            yield from (apply_rules(rules, stream) if rules else stream)
+            yield from (apply_rules(rules, stream, workers=self.cfg.rule_workers)
+                        if rules else stream)
 
     def process_work(self, work: dict) -> WorkResult:
         t0 = time.time()

@@ -113,16 +113,91 @@ def parse_rules(lines, on_error: str = "skip"):
     return out
 
 
-def apply_rules(rules, words):
+def apply_rules(rules, words, workers: int = 0):
     """Expand: yield every (rule, word) mangling, skipping rejects.
 
-    Order matches hashcat --stdout: for each word, each rule in file order.
+    Order matches hashcat --stdout: for each word, each rule in file
+    order — with or without ``workers``, so resume skip-by-count and
+    differential tests see one canonical stream.
+
+    ``workers > 1`` fans the expansion over a process pool in
+    order-preserving chunks: single-process expansion sustains ~0.8M
+    cand/s, enough to feed one v5e chip (~230k PMK/s) but not a mesh
+    (SURVEY §7.3.3 "keeping the device fed"); the pool scales the host
+    side roughly linearly until packing/H2D dominates.
     """
+    if workers and workers > 1:
+        yield from _apply_rules_pooled(rules, words, workers)
+        return
     for word in words:
         for rule in rules:
             w = rule.apply(word)
             if w is not None:
                 yield w
+
+
+_WORKER_RULES = {}  # worker-side: rules-key -> parsed [Rule]
+_POOLS = {}         # parent-side: worker count -> live Pool (reused)
+
+
+def _pool_expand(args):
+    key, texts, chunk = args
+    rules = _WORKER_RULES.get(key)
+    if rules is None:
+        # texts ride along with every chunk (~1 KB) so the pool can be
+        # reused across different rule sets; each worker parses a given
+        # set once and caches it
+        rules = _WORKER_RULES.setdefault(key, [parse_rule(t) for t in texts])
+    out = []
+    for word in chunk:
+        for rule in rules:
+            w = rule.apply(word)
+            if w is not None:
+                out.append(w)
+    return out
+
+
+def _get_pool(workers: int):
+    """One long-lived pool per worker count, shared by every
+    apply_rules call in the process — a work unit streams up to ~17
+    dictionaries and must not pay interpreter spawn for each."""
+    pool = _POOLS.get(workers)
+    if pool is None:
+        import atexit
+        import multiprocessing
+
+        # spawn, not fork: the calling client runs with jax's thread
+        # pools live, and forking a threaded process can deadlock.
+        # Spawn imposes the standard multiprocessing contract — the
+        # caller's __main__ must be import-safe (true for ``python -m
+        # dwpa_tpu.client`` and the guarded zipapp stub).
+        ctx = multiprocessing.get_context("spawn")
+        pool = ctx.Pool(workers)
+        _POOLS[workers] = pool
+        atexit.register(pool.terminate)
+    return pool
+
+
+def _apply_rules_pooled(rules, words, workers, chunk_words: int = 2048):
+    import collections
+    import itertools
+
+    texts = tuple(r.text for r in rules)
+    key = hash(texts)
+    it = iter(words)
+    chunks = iter(lambda: list(itertools.islice(it, chunk_words)), [])
+    pool = _get_pool(workers)
+    # Bounded in-flight window: submit at most workers+2 chunks ahead of
+    # the consumer, so a slow downstream (the device feed) applies
+    # backpressure instead of the expanded keyspace piling up in RAM
+    # (imap's result cache is unbounded).
+    pending = collections.deque()
+    for chunk in chunks:
+        pending.append(pool.apply_async(_pool_expand, ((key, texts, chunk),)))
+        if len(pending) > workers + 2:
+            yield from pending.popleft().get()
+    while pending:
+        yield from pending.popleft().get()
 
 
 # ---------------------------------------------------------------------------

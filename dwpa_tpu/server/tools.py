@@ -346,8 +346,11 @@ def pack_client(hcdir: str, version: str = None) -> dict:
                 with open(full, "rb") as f:
                     z.writestr(info, f.read())
                 count += 1
-        stub = ("from dwpa_tpu.client.__main__ import main\n"
-                "main()\n")
+        # __name__ guard: rule-expansion worker processes (spawn) re-import
+        # __main__, which must not re-enter the client
+        stub = ("if __name__ == '__main__':\n"
+                "    from dwpa_tpu.client.__main__ import main\n"
+                "    main()\n")
         info = zipfile.ZipInfo("__main__.py", date_time=(1980, 1, 1, 0, 0, 0))
         z.writestr(info, stub)
     with open(pyz, "rb") as f:

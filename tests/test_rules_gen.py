@@ -199,3 +199,15 @@ def test_wpa_rules_expand_expected_shapes():
                    b"password123", b"password2024", b"p@ssword",
                    b"passw0rd", b"drowssap", b"passwordpassword"):
         assert expect in out, expect
+
+
+def test_apply_rules_pooled_matches_serial():
+    """workers>1 must yield the exact serial stream (order included) —
+    resume skip-by-count depends on it."""
+    from dwpa_tpu.rules import apply_rules, parse_rules
+
+    rules = parse_rules([":", "c", "$1", "se3", "r", "] ]"])
+    words = [b"poolword%04d" % i for i in range(500)]
+    serial = list(apply_rules(rules, words))
+    pooled = list(apply_rules(rules, iter(words), workers=3))
+    assert pooled == serial
