@@ -67,3 +67,82 @@ def mask_words(mask: str, custom: dict = None, skip: int = 0, limit: int = None)
             rem, d = divmod(rem, sizes[p])
             word[p] = alphas[p][d]
         yield bytes(word)
+
+
+def mask_digits_at(mask: str, idx: int, custom: dict = None):
+    """Mixed-radix digit vector (last position fastest) for keyspace
+    index ``idx`` — the host-side seed for the on-device generator
+    (arbitrary-precision here, so keyspaces beyond 2^32 slice fine)."""
+    alphas = parse_mask(mask, custom)
+    digits = [0] * len(alphas)
+    rem = idx
+    for p in range(len(alphas) - 1, -1, -1):
+        rem, digits[p] = divmod(rem, len(alphas[p]))
+    return digits
+
+
+def _device_mask_impl(alphas, batch, start_digits):
+    import jax.numpy as jnp
+
+    carry = jnp.arange(batch, dtype=jnp.uint32)
+    byte_cols = [None] * len(alphas)
+    for p in range(len(alphas) - 1, -1, -1):
+        radix = jnp.uint32(len(alphas[p]))
+        total = carry + start_digits[p]
+        digit = total % radix
+        carry = total // radix
+        lut = jnp.asarray(list(alphas[p]), dtype=jnp.uint32)
+        byte_cols[p] = lut[digit]  # [batch]
+    words = []
+    for w in range(16):
+        acc = jnp.zeros((batch,), dtype=jnp.uint32)
+        for k in range(4):
+            p = w * 4 + k
+            if p < len(byte_cols):
+                acc = acc | (byte_cols[p] << jnp.uint32(8 * (3 - k)))
+        words.append(acc)
+    return jnp.stack(words, axis=1)  # [batch, 16]
+
+
+_device_mask_jits = {}  # output sharding (or None) -> jitted generator
+
+
+def device_mask_words(mask: str, start: int, batch: int, custom: dict = None,
+                      sharding=None):
+    """uint32[batch, 16] packed HMAC key blocks for ``batch`` consecutive
+    mask words starting at keyspace index ``start`` — generated entirely
+    on device (SURVEY §7 M5: the pure iota→digits generator; no host
+    packing, no H2D of candidates).
+
+    The host contributes only the O(positions) starting digit vector
+    (as *data*, so one compilation per (mask shape, batch) serves every
+    keyspace slice); the device runs a carry chain over positions
+    (least-significant last, matching ``mask_words`` order), maps digits
+    through the per-position alphabets, and packs big-endian words.
+    The absolute keyspace index is unbounded — it never crosses to the
+    device, only its per-position digit remainders do.
+
+    ``sharding``: an optional NamedSharding for the output — XLA's SPMD
+    partitioner then generates each candidate shard directly on its
+    owning device (each device materializes only its slice of the iota),
+    so a mesh consumes the batch with no generation bottleneck and no
+    redistribution, on one host or many.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fn = _device_mask_jits.get(sharding)
+    if fn is None:
+        kw = {} if sharding is None else {"out_shardings": sharding}
+        fn = jax.jit(_device_mask_impl, static_argnames=("alphas", "batch"),
+                     **kw)
+        _device_mask_jits[sharding] = fn
+    alphas = tuple(parse_mask(mask, custom))
+    if len(alphas) > 63:
+        raise ValueError(f"mask has {len(alphas)} positions; a WPA PSK "
+                         "caps at 63")
+    if not 0 < batch < 2**31:
+        raise ValueError(f"batch {batch} outside (0, 2^31) — the "
+                         "within-batch carry chain is uint32")
+    digits = jnp.asarray(mask_digits_at(mask, start, custom), dtype=jnp.uint32)
+    return fn(alphas, batch, digits)

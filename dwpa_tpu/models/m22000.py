@@ -562,3 +562,64 @@ class M22000Engine:
         if in_flight is not None:
             finish(*in_flight)
         return founds
+
+    def crack_mask(self, mask: str, skip: int = 0, limit: int = None,
+                   custom: dict = None, on_batch=None) -> list:
+        """Mask attack with on-device candidate generation.
+
+        Unlike ``crack``, no candidate bytes ever exist host-side: each
+        batch is generated by ``gen.mask.device_mask_words`` (SURVEY §7
+        M5 — iota→digits→pack, one fused program) and fed straight to
+        the crack steps, so the only host work per batch is an
+        O(positions) digit vector and the hits-gate scalar.  Words are
+        materialized lazily from their keyspace index only for the rare
+        hit columns.  ``skip``/``limit`` slice the keyspace exactly like
+        ``gen.mask.mask_words`` (hashcat -s/-l semantics).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..gen.mask import device_mask_words, mask_keyspace, mask_words
+        from ..parallel.mesh import DP_AXIS
+
+        class _LazyWords:
+            """pws stand-in: index -> word bytes, computed on demand."""
+
+            def __init__(self, start):
+                self.start = start
+
+            def __getitem__(self, b):
+                return next(mask_words(mask, custom,
+                                       skip=self.start + b, limit=1))
+
+        total = mask_keyspace(mask, custom)
+        end = total if limit is None else min(total, skip + limit)
+        founds = []
+        in_flight = None
+        pos = skip
+        while True:
+            nxt = None
+            if pos < end and self.groups:
+                n = min(self.batch_size, end - pos)
+                # generate a full mesh-multiple; _collect masks columns
+                # past nvalid (wrap-around words never count)
+                gen = -(-n // self.mesh.size) * self.mesh.size
+                t0 = time.perf_counter()
+                # generated directly under the dp sharding: each device
+                # (across all hosts) materializes only its own candidate
+                # shard — no redistribution, no host-side bytes
+                pw_words = device_mask_words(
+                    mask, pos, gen, custom,
+                    sharding=NamedSharding(self.mesh, P(DP_AXIS, None)),
+                )
+                self.stage_times["prepare"] += time.perf_counter() - t0
+                nxt = (self._dispatch((_LazyWords(pos), n, pw_words)), n)
+                pos += n
+            if in_flight is not None:
+                dispatched, raw = in_flight
+                new = self._collect(dispatched)
+                founds.extend(new)
+                if on_batch is not None:
+                    on_batch(raw, new)
+            in_flight = nxt
+            if in_flight is None:
+                return founds

@@ -148,3 +148,29 @@ def test_crack_step_bucket_pad_and_reorder():
     assert found[1, 0, batch // 2] and not found[1, 1:, :].any()
     found[:, :, batch // 2] = False
     assert not found.any()
+
+
+def test_crack_mask_device_generated():
+    """crack_mask: on-device iota->digits generation end to end, founds
+    identical to the host-packed path, skip/limit slicing honored."""
+    psk = b"77345678"  # inside ?d x8
+    lines = [T.make_pmkid_line(psk, ESSID, seed="mk1"),
+             T.make_eapol_line(psk, ESSID, keyver=2, seed="mk2")]
+    eng = m.M22000Engine(lines, batch_size=64, mesh=default_mesh())
+    founds = eng.crack_mask("?d?d?d?d?d?d?d?d", skip=77345600, limit=256)
+    assert sorted(f.psk for f in founds) == [psk, psk]
+    # a slice that excludes the PSK finds nothing
+    eng2 = m.M22000Engine(lines, batch_size=64, mesh=default_mesh())
+    assert eng2.crack_mask("?d?d?d?d?d?d?d?d", skip=0, limit=128) == []
+
+
+def test_device_mask_words_matches_host_pack():
+    from dwpa_tpu.gen.mask import device_mask_words, mask_words
+
+    for mask, start in (("?d?d?d?d?d?d?d?d", 0),
+                        ("?d?d?d?d?d?d?d?d", 99999980),
+                        ("ab?l?d", 7),
+                        ("?d?d?d?d?d?d?d?d?d?d", 9_999_999_000)):
+        dev = np.array(device_mask_words(mask, start, 16))
+        ref = bo.pack_passwords_be(list(mask_words(mask, skip=start, limit=16)))
+        np.testing.assert_array_equal(dev, ref, err_msg=f"{mask}@{start}")
