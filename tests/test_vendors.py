@@ -133,3 +133,48 @@ def test_precompute_cracks_mac_tail_default(core):
     stats = keygen_precompute(core)
     assert stats["cracked"] == 1
     assert core.db.q1("SELECT algo FROM nets")["algo"] == "MacTail"
+
+
+# ---------------------------------------------------------------------------
+# WPS-PIN default-key family
+
+
+def test_wps_checksum_is_valid_wsc():
+    from dwpa_tpu.gen.vendors import wps_checksum_digit
+
+    # WSC §7.4.1 validity: 3*(d1+d3+d5+d7) + (d2+d4+d6+d8) ≡ 0 (mod 10)
+    for pin7 in (1234567, 0, 9999999, 2017480):
+        pin = pin7 * 10 + wps_checksum_digit(pin7)
+        digits = [int(c) for c in "%08d" % pin]
+        acc = 3 * sum(digits[0::2]) + sum(digits[1::2])
+        assert acc % 10 == 0, pin
+
+
+def test_wps_pin_keys_shape_and_mac_derivation():
+    from dwpa_tpu.gen.vendors import wps_pin_keys
+
+    bssid = bytes.fromhex("c83a35123456")
+    keys = list(wps_pin_keys(bssid))
+    assert all(len(k) == 8 and k.isdigit() for k in keys)
+    # the zero-delta pin embeds mac[3:] % 10^7 as its data digits
+    assert keys[0][:7] == b"%07d" % (0x123456 % 10_000_000)
+    assert b"12345670" in keys  # static factory pin rides along
+
+
+def test_wps_pin_net_cracked_by_precompute():
+    from dwpa_tpu.gen.vendors import wps_pin_keys
+    from dwpa_tpu.server.core import ServerCore
+    from dwpa_tpu.server.db import Database
+    from dwpa_tpu.server.jobs import keygen_precompute
+    from dwpa_tpu import testing as tfx
+
+    bssid = bytes.fromhex("c83a35123456")
+    psk = list(wps_pin_keys(bssid))[0]
+    line = tfx.make_pmkid_line(psk, b"TP-LINK_123456", seed="wps1",
+                               mac_ap=bssid)
+    core = ServerCore(Database(":memory:"))
+    core.add_hashlines([line])
+    out = keygen_precompute(core)
+    assert out["cracked"] == 1
+    net = core.db.q1("SELECT algo, pass FROM nets")
+    assert net["algo"] == "WPSPin" and net["pass"] == psk
