@@ -137,6 +137,24 @@ def bench_multi_bssid(words: int) -> dict:
             "net_checks_per_s": words * n_nets / dt}
 
 
+def bench_dict_steady(batch: int, batches: int = 4) -> dict:
+    """Engine product path at full batch: streaming dict crack with the
+    two-deep pipeline (pack + H2D + hits-gate overlapped with compute).
+    The gap to mask_pbkdf2 is the end-to-end overhead the engine fails
+    to hide."""
+    engine = M22000Engine(
+        [T.make_pmkid_line(b"steadypass9", b"bench-steady", seed="st")],
+        batch_size=batch,
+    )
+    engine.crack_batch([b"warm-%07d" % i for i in range(batch)])
+    n = batches * batch
+    t0 = time.perf_counter()
+    engine.crack(b"run-%08d" % i for i in range(n))
+    dt = time.perf_counter() - t0
+    return {"label": "dict_steady", "words": n, "seconds": dt,
+            "pmk_per_s": n / dt}
+
+
 def _round(cfg: dict) -> dict:
     return {k: round(v, 4) if isinstance(v, float) else v for k, v in cfg.items()}
 
@@ -155,6 +173,7 @@ def main():
     )
     rules = bench_rules_dict(words)
     multi = bench_multi_bssid(words)
+    steady = bench_dict_steady(batch)
 
     value = mask["pmk_per_s"]
     print(
@@ -171,6 +190,7 @@ def main():
                     "eapol_dict": _round(eapol),
                     "rules_dict": _round(rules),
                     "multi_bssid": _round(multi),
+                    "dict_steady": _round(steady),
                 },
             }
         )

@@ -542,13 +542,23 @@ class M22000Engine:
         def submit(b):
             nonlocal in_flight
             prep = self._prepare(b)        # async H2D starts here
+            # Dispatch N+1 BEFORE syncing on batch N: the device queue
+            # always holds the next batch, so the hits-gate round trip
+            # and found-decode of N overlap N+1's compute instead of
+            # idling the chip (~17% of steady-state on the tunnelled
+            # chip).  A find in N is still honored for N+1 at decode
+            # time — _collect masks rows by the live-net set.
+            nxt = None
+            if prep is not None and self.groups:
+                nxt = (self._dispatch(prep), len(b))  # launch N+1
             if in_flight is not None:
                 finish(*in_flight)         # sync on batch N
-                in_flight = None
-            if prep is not None and self.groups:
-                in_flight = (self._dispatch(prep), len(b))  # launch N+1
-            elif on_batch is not None:
-                on_batch(len(b), [])       # nothing dispatchable: still consumed
+            if nxt is None and on_batch is not None:
+                # nothing dispatchable: still consumed — reported only
+                # AFTER batch N's finish so checkpoints stay in stream
+                # order (the client's resume skip-by-count depends on it)
+                on_batch(len(b), [])
+            in_flight = nxt
 
         for pw in candidates:
             if not self.groups and in_flight is None:
