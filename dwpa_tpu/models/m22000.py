@@ -341,6 +341,21 @@ class Found:
     pmk: bytes
 
 
+class _PackedWords:
+    """Lazy pws view over native-packed rows: ``[b]`` reconstructs the
+    decoded candidate bytes from its packed key block + length, so the
+    word is only materialized for the rare hit columns."""
+
+    __slots__ = ("words", "lens")
+
+    def __init__(self, words, lens):
+        self.words = words
+        self.lens = lens
+
+    def __getitem__(self, b):
+        return bo.words_to_bytes_be(self.words[b])[: int(self.lens[b])]
+
+
 class M22000Engine:
     """Crack a set of m22000 hashlines with batches of candidate PSKs.
 
@@ -430,21 +445,48 @@ class M22000Engine:
         batch's steps are still executing overlaps the transfer with
         compute (see ``crack``).
         """
+        from ..parallel import shard_candidates
+
         t0 = time.perf_counter()
+        plist = passwords if isinstance(passwords, list) else list(passwords)
+        if not plist:
+            return None
+        # Pad to batch_size (or, for an oversize caller-supplied batch, up
+        # to the next mesh-size multiple so the shard_map split stays even).
+        cap = max(self.batch_size,
+                  -(-len(plist) // self.mesh.size) * self.mesh.size)
+        # Native fast path: $HEX decode + length filter + pack fused in
+        # one C pass (native/pack_fast.cpp) — the host feed must outrun
+        # a mesh, not one chip.  Falls back to the Python pipeline when
+        # the library is unavailable or the batch isn't plain bytes.
+        from ..native import pack_candidates_fast
+
+        fast = pack_candidates_fast(plist, MIN_PSK_LEN, MAX_PSK_LEN,
+                                    capacity=cap)
+        if fast is not None:
+            packed, lens, nvalid = fast
+            if nvalid == 0:
+                return None
+            # Size the device batch from the post-filter count, exactly
+            # like the fallback: an oversize batch full of invalid words
+            # must not inflate the shape (extra zero-row PBKDF2s and a
+            # fresh jit entry).
+            target = max(self.batch_size,
+                         -(-nvalid // self.mesh.size) * self.mesh.size)
+            pw_words = shard_candidates(self.mesh, packed[:target])
+            self.stage_times["prepare"] += time.perf_counter() - t0
+            return _PackedWords(packed, lens), nvalid, pw_words
+
         # $HEX[...] notation decodes to raw bytes before hashing, matching
         # the server's candidate handling (hc_unhex, web/common.php:3-25).
-        pws = [oracle.hc_unhex(p) for p in passwords]
+        pws = [oracle.hc_unhex(p) for p in plist]
         pws = [p for p in pws if MIN_PSK_LEN <= len(p) <= MAX_PSK_LEN]
         if not pws:
             return None
         nvalid = len(pws)
-        # Pad to batch_size (or, for an oversize caller-supplied batch, up
-        # to the next mesh-size multiple so the shard_map split stays even).
         target = max(self.batch_size, -(-nvalid // self.mesh.size) * self.mesh.size)
         if nvalid < target:
             pws = pws + [b"\x00" * MIN_PSK_LEN] * (target - nvalid)
-        from ..parallel import shard_candidates
-
         pw_words = shard_candidates(self.mesh, bo.pack_passwords_be(pws))
         self.stage_times["prepare"] += time.perf_counter() - t0
         return pws, nvalid, pw_words

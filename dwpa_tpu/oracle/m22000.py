@@ -38,15 +38,26 @@ PRF_LABEL_V12 = b"Pairwise key expansion\x00"
 PRF_LABEL_V3 = b"\x01\x00Pairwise key expansion"
 
 
+_XDIGITS = frozenset(b"0123456789abcdefABCDEF")
+
+
 def hc_unhex(key):
-    """Decode hashcat $HEX[...] candidate notation to raw bytes."""
+    """Decode hashcat $HEX[...] candidate notation to raw bytes.
+
+    Strict per the reference (web/common.php:3-25): the payload must be
+    even-length pure xdigits (``ctype_xdigit`` — no whitespace, which
+    ``bytes.fromhex`` would forgive); anything else is taken literally.
+    ``$HEX[]`` decodes to the empty string, as the reference's second
+    branch does.
+    """
     if isinstance(key, str):
         key = key.encode("utf-8", errors="ignore")
     if key.startswith(b"$HEX[") and key.endswith(b"]"):
-        try:
-            return bytes.fromhex(key[5:-1].decode())
-        except ValueError:
-            return key
+        k = key[5:-1]
+        if k == b"":
+            return b""
+        if len(k) % 2 == 0 and all(c in _XDIGITS for c in k):
+            return bytes.fromhex(k.decode())
     return key
 
 

@@ -1,0 +1,94 @@
+"""Native candidate packer (native/pack_fast.cpp) — differential vs the
+Python pipeline (oracle.hc_unhex + length filter + pack_passwords_be),
+plus engine integration."""
+
+import numpy as np
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.models import m22000 as m
+from dwpa_tpu.native import load_pack, pack_candidates_fast
+from dwpa_tpu.oracle.m22000 import hc_unhex
+from dwpa_tpu.utils import bytesops as bo
+
+pytestmark = pytest.mark.skipif(
+    load_pack() is None, reason="native pack library unavailable"
+)
+
+
+def _python_pipeline(words):
+    pws = [hc_unhex(w) for w in words]
+    return [p for p in pws if 8 <= len(p) <= 63]
+
+
+CASES = [
+    [b"password01", b"short", b"okaypass9"],
+    [b"$HEX[41414141415a5a5a]", b"$HEX[zzzz]pad", b"$HEX[61]"],
+    [b"x" * 63, b"x" * 64, b"y" * 8, b"z" * 7],
+    [b"$HEX[" + b"61" * 63 + b"]", b"$HEX[" + b"62" * 64 + b"]"],
+    [bytes(range(8, 40)), b"emb\x00edded0", b"nl\nin\nword"],
+    [b"$HEX[4141414141414141"],  # unterminated wrapper: literal
+    [],
+    [b"", b"\r\n", b"1234567"],  # nothing valid
+]
+
+
+@pytest.mark.parametrize("words", CASES)
+def test_differential_vs_python(words):
+    exp = _python_pipeline(words)
+    out, lens, n = pack_candidates_fast(words, 8, 63)
+    assert n == len(exp)
+    for i, w in enumerate(exp):
+        assert bo.words_to_bytes_be(out[i])[: lens[i]] == w
+        np.testing.assert_array_equal(out[i], bo.pack_passwords_be([w])[0])
+    assert not out[n:].any()  # capacity rows stay zero
+
+
+def test_capacity_padding():
+    out, lens, n = pack_candidates_fast([b"password1"], 8, 63, capacity=32)
+    assert out.shape == (32, 16) and n == 1
+    assert not out[1:].any()
+
+
+def test_engine_uses_fast_path_same_founds():
+    """The engine cracks identically through the native prepare path
+    (plain bytes list) and the Python fallback (str candidates force
+    it), $HEX decode included."""
+    psk = b"A" * 5 + b"\xc3\xa9abc"  # non-ascii: arrives as $HEX from wires
+    line = tfx.make_pmkid_line(psk, b"PackNet", seed="np1")
+    words = [b"chaff-%04d" % i for i in range(63)]
+    hexed = b"$HEX[" + psk.hex().encode() + b"]"
+
+    eng_fast = m.M22000Engine([line], batch_size=64)
+    f_fast = eng_fast.crack_batch(words + [hexed])
+
+    eng_slow = m.M22000Engine([line], batch_size=64)
+    f_slow = eng_slow.crack_batch([w.decode("latin1") for w in words]
+                                  + [hexed.decode("latin1")])
+    assert [f.psk for f in f_fast] == [psk]
+    assert [(f.psk, f.pmk) for f in f_fast] == [(f.psk, f.pmk) for f in f_slow]
+
+
+def test_hc_unhex_strict_xdigit_matches_reference():
+    """Whitespace in the $HEX payload is literal, not decoded — PHP
+    ctype_xdigit semantics (web/common.php:3-25); native and Python
+    paths must agree."""
+    w = b"$HEX[61 62 63 64 65 66 67 68]"
+    assert hc_unhex(w) == w  # literal, 29 bytes
+    out, lens, n = pack_candidates_fast([w], 8, 63)
+    assert n == 1 and lens[0] == len(w)
+    assert hc_unhex(b"$HEX[]") == b""
+
+
+def test_oversize_invalid_heavy_batch_keeps_shape():
+    """Shape parity with the fallback: invalid words must not inflate
+    the device batch."""
+    eng = m.M22000Engine(
+        [tfx.make_pmkid_line(b"password1", b"ShapeNet", seed="sh1")],
+        batch_size=8,
+    )
+    words = [b"ok-word%03d" % i for i in range(10)] + [b"bad"] * 30
+    prep = eng._prepare(words)
+    pws, nvalid, pw_words = prep
+    assert nvalid == 10
+    assert pw_words.shape[0] == 16  # ceil(10/8)*8, not 40
