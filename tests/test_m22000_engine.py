@@ -124,3 +124,43 @@ def test_short_candidates_filtered():
     eng = M22000Engine([tfx.make_pmkid_line(psk, b"Len")], batch_size=BATCH)
     founds = eng.crack([b"short", b"x" * 64, psk])
     assert [f.psk for f in founds] == [psk]
+
+
+def test_randomized_differential_vs_oracle():
+    """Seeded fuzz: random (keyver, NC delta/endian, hint bits, essid and
+    psk lengths incl. binary bytes) configurations must crack on device
+    exactly when the oracle accepts them, with matching PMK/nc/endian."""
+    import random
+
+    rng = random.Random(0xD3AD)
+    lines, psks = [], []
+    for i in range(14):
+        essid = bytes(rng.randrange(1, 256) for _ in range(rng.randrange(1, 33)))
+        psk = bytes(rng.randrange(1, 256) for _ in range(rng.randrange(8, 64)))
+        if rng.random() < 0.3:
+            line = tfx.make_pmkid_line(psk, essid, seed=f"fz{i}")
+        else:
+            keyver = rng.choice([1, 2, 3])
+            delta = rng.choice([0, 0, 1, -1, 2, -2, 4, -4, 5])
+            endian = rng.choice(["LE", "BE"])
+            mp = 0
+            if delta and rng.random() < 0.5:
+                mp |= hl.MP_LE if endian == "LE" else hl.MP_BE
+            line = tfx.make_eapol_line(psk, essid, keyver=keyver,
+                                     nc_delta=delta, endian=endian,
+                                     message_pair=mp, seed=f"fz{i}")
+        lines.append(line)
+        psks.append(psk)
+
+    eng = M22000Engine(lines, batch_size=32)
+    chaff = [bytes(rng.randrange(1, 256) for _ in range(10)) for _ in range(40)]
+    founds = eng.crack(chaff + psks)
+    by_line = {f.line.raw: f for f in founds}
+    assert len(founds) == len(lines)
+    for line, psk in zip(lines, psks):
+        f = by_line[line]
+        ref = oracle.check_key_m22000(hl.parse(line), [psk])
+        assert ref is not None
+        assert (f.psk, f.pmk) == (psk, ref[3])
+        assert f.nc == (ref[1] or 0)
+        assert (f.endian or "") == (ref[2] or "")
