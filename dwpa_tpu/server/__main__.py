@@ -58,6 +58,19 @@ def cmd_serve(args):
     from .api import make_wsgi_app
 
     app = make_wsgi_app(_core(args))
+    if getattr(args, "with_jobs", False):
+        # The cron layer in-process: its own ServerCore (sqlite handles
+        # are not shared across threads; WAL serializes the writers).
+        import threading
+
+        if args.db == ":memory:":
+            raise SystemExit("--with-jobs needs a file-backed --db "
+                             "(a second :memory: handle would be empty)")
+        jobs_core = _core(args)
+        geo, psk = _job_lookups(args)  # validate sources before the thread
+        threading.Thread(
+            target=_jobs_loop, args=(jobs_core, args, geo, psk), daemon=True
+        ).start()
     host = args.host or "127.0.0.1"
     port = args.port if args.port is not None else 8080
     with make_server(host, port, app) as srv:
@@ -89,6 +102,15 @@ def _psk_lookup_from_file(path):
     return lambda macs: {m: table[m] for m in macs if m in table}
 
 
+def _job_lookups(args):
+    """Build the offline geo/PSK lookup callables — ONCE, and before any
+    background thread starts, so a bad path or malformed file fails the
+    command loudly instead of silently killing the cron layer."""
+    geo = _geo_lookup_from_file(args.geo_file) if args.geo_file else None
+    psk = _psk_lookup_from_file(args.psk_file) if args.psk_file else None
+    return geo, psk
+
+
 def cmd_jobs(args):
     """The cron layer: one shot of maintenance + keygen (+ geolocation /
     PSK lookup when a source is configured) by default, or continuous
@@ -97,8 +119,7 @@ def cmd_jobs(args):
     from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
 
     core = _core(args)
-    geo = _geo_lookup_from_file(args.geo_file) if args.geo_file else None
-    psk = _psk_lookup_from_file(args.psk_file) if args.psk_file else None
+    geo, psk = _job_lookups(args)
     if not args.loop:
         out = {"maintenance": maintenance(core),
                "keygen": keygen_precompute(core)}
@@ -108,19 +129,36 @@ def cmd_jobs(args):
             out["psk_lookup"] = psk_lookup(core, psk)
         print(json.dumps(out, default=str))
         return
+    _jobs_loop(core, args, geo, psk)
+
+
+def _jobs_loop(core, args, geo, psk):
+    """The continuous cron layer (INSTALL.md:47-52 cadence); shared by
+    ``jobs --loop`` and ``serve --with-jobs``.  Transient job errors
+    (sqlite lock contention, I/O hiccups) are logged and retried next
+    tick — one bad pass must not end the cron layer for good."""
+    import sys
+    import traceback
+
+    from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
+
     last_maint = last_enrich = 0.0
     while True:
         now = time.time()
-        if now - last_maint >= args.maint_interval:
-            maintenance(core)
-            last_maint = now
-        if (geo or psk) and now - last_enrich >= args.enrich_interval:
-            if geo:
-                geolocate(core, geo)
-            if psk:
-                psk_lookup(core, psk)
-            last_enrich = now
-        keygen_precompute(core)
+        try:
+            if now - last_maint >= args.maint_interval:
+                maintenance(core)
+                last_maint = now
+            if (geo or psk) and now - last_enrich >= args.enrich_interval:
+                if geo:
+                    geolocate(core, geo)
+                if psk:
+                    psk_lookup(core, psk)
+                last_enrich = now
+            keygen_precompute(core)
+        except Exception:
+            print("jobs tick failed (will retry):", file=sys.stderr)
+            traceback.print_exc()
         time.sleep(args.keygen_interval)
 
 
@@ -208,6 +246,18 @@ def main(argv=None):
         sp.add_argument("--dictdir")
         sp.add_argument("--capdir")
 
+    def jobs_flags(sp):
+        """Cron-layer knobs, shared by `jobs` and `serve --with-jobs`."""
+        sp.add_argument("--maint-interval", type=float, default=3600)
+        sp.add_argument("--keygen-interval", type=float, default=300)
+        sp.add_argument("--enrich-interval", type=float, default=600,
+                        help="geolocate/psk-lookup cadence (wigle.php/"
+                             "3wifi.php run every 10 min)")
+        sp.add_argument("--geo-file", help="offline geolocation JSON "
+                                           "{mac_hex: {lat, lon, ...}}")
+        sp.add_argument("--psk-file", help="offline PSK database, lines of "
+                                           "mac_hex:psk (3wifi-dump style)")
+
     sp = sub.add_parser("serve", help="run the HTTP API + UI")
     common(sp)
     sp.add_argument("--host", default=None, help="bind address (default 127.0.0.1)")
@@ -217,20 +267,16 @@ def main(argv=None):
     sp.add_argument("--bosskey", help="32-hex superuser key (conf.php)")
     sp.add_argument("--hcdir", help="client-distribution dir (web/hc/): "
                                     "dwpa_tpu.version + dwpa_tpu.pyz")
+    sp.add_argument("--with-jobs", action="store_true",
+                    help="run the cron layer as a background thread of "
+                         "this process (single-process deployment)")
+    jobs_flags(sp)
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("jobs", help="run maintenance + keygen precompute")
     common(sp)
     sp.add_argument("--loop", action="store_true")
-    sp.add_argument("--maint-interval", type=float, default=3600)
-    sp.add_argument("--keygen-interval", type=float, default=300)
-    sp.add_argument("--enrich-interval", type=float, default=600,
-                    help="geolocate/psk-lookup cadence (wigle.php/3wifi.php"
-                         " run every 10 min)")
-    sp.add_argument("--geo-file", help="offline geolocation JSON "
-                                       "{mac_hex: {lat, lon, country, ...}}")
-    sp.add_argument("--psk-file", help="offline PSK database, lines of "
-                                       "mac_hex:psk (3wifi-dump style)")
+    jobs_flags(sp)
     sp.set_defaults(fn=cmd_jobs)
 
     sp = sub.add_parser("recrack", help="re-verify every cracked net")

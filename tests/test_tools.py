@@ -422,3 +422,8 @@ def test_cli_pack_client_reads_conf(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out)
     assert os.path.isfile(tmp_path / "hc" / "dwpa_tpu.version")
     assert out["files"] > 20
+
+
+def test_serve_with_jobs_rejects_memory_db():
+    with pytest.raises(SystemExit, match="file-backed"):
+        cli_main(["serve", "--db", ":memory:", "--with-jobs"])
