@@ -53,9 +53,18 @@ def _core(args):
 
 
 def cmd_serve(args):
-    from wsgiref.simple_server import make_server
+    import socketserver
+    from wsgiref.simple_server import WSGIServer, make_server
 
     from .api import make_wsgi_app
+
+    class ThreadingWSGIServer(socketserver.ThreadingMixIn, WSGIServer):
+        """One thread per request, like the reference under Apache
+        prefork: a slow capture upload must not block get_work for the
+        whole fleet.  Database serializes statements; get_work holds the
+        scheduler mutex (core.py)."""
+
+        daemon_threads = True
 
     app = make_wsgi_app(_core(args))
     if getattr(args, "with_jobs", False):
@@ -73,7 +82,8 @@ def cmd_serve(args):
         ).start()
     host = args.host or "127.0.0.1"
     port = args.port if args.port is not None else 8080
-    with make_server(host, port, app) as srv:
+    with make_server(host, port, app,
+                     server_class=ThreadingWSGIServer) as srv:
         print(f"dwpa_tpu server on http://{host}:{port}/", flush=True)
         srv.serve_forever()
 

@@ -28,6 +28,7 @@ import os
 import re
 import secrets
 import sqlite3
+import threading
 
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
@@ -72,6 +73,11 @@ class ServerCore:
         self.bosskey = bosskey        # 32-hex superuser key (conf.php)
         self.captcha = captcha        # callable(response, ip) -> bool, or None
         self.base_url = base_url      # public URL for mailed links
+        # Global mutex around the work-unit issue critical section, the
+        # reference's SHM lockfile (create_lock('get_work.lock'),
+        # get_work.php:49): without it two concurrent volunteers could
+        # select the same target net before either records its leases.
+        self._getwork_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # Ingestion
@@ -89,11 +95,17 @@ class ServerCore:
             localfile = os.path.join(self.capdir, md5.hex())
             with open(localfile, "wb") as f:
                 f.write(blob)
-        cur = self.db.x(
-            "INSERT INTO submissions(localfile, hash, ip) VALUES (?, ?, ?)",
+        # OR IGNORE + re-select: under the threaded server two identical
+        # uploads can both pass the dedup SELECT; the UNIQUE(hash) row
+        # must win quietly, not 500 the second client.
+        self.db.x(
+            "INSERT OR IGNORE INTO submissions(localfile, hash, ip) "
+            "VALUES (?, ?, ?)",
             (localfile, md5, ip),
         )
-        return cur.lastrowid
+        return self.db.q1(
+            "SELECT s_id FROM submissions WHERE hash = ?", (md5,)
+        )["s_id"]
 
     def add_hashlines(self, lines, s_id: int = None, ip: str = "",
                       userkey: str = None) -> dict:
@@ -202,9 +214,14 @@ class ServerCore:
     def get_work(self, dictcount: int) -> dict:
         """Build one work unit or return None ("No nets").
 
-        sqlite serializes writers, which stands in for the reference's
-        global SHM lock around this critical section (get_work.php:49).
+        Held under the global get_work mutex (the reference's SHM lock,
+        get_work.php:49,138): target selection and lease recording must
+        be atomic with respect to other volunteers.
         """
+        with self._getwork_lock:
+            return self._get_work_locked(dictcount)
+
+    def _get_work_locked(self, dictcount: int) -> dict:
         dictcount = max(1, min(MAX_DICTCOUNT, int(dictcount)))
         target = self.db.q1(
             """SELECT net_id, ssid FROM nets

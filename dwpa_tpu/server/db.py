@@ -11,11 +11,14 @@ translation:
   writers stay consistent;
 - the nets.hash / submissions.hash uniqueness + INSERT OR IGNORE give the
   same idempotent-ingestion semantics;
-- WAL journal + a single write connection per process stand in for the
-  reference's SHM lockfile around the get_work critical section.
+- WAL journal + a statement-level lock on the shared connection make the
+  handle thread-safe under the threaded server; the larger critical
+  section the reference guards with its SHM lockfile (work-unit issue)
+  is ServerCore._getwork_lock.
 """
 
 import sqlite3
+import threading
 import time
 
 SCHEMA = """
@@ -148,10 +151,18 @@ STAT_NAMES = [
 
 
 class Database:
-    """One sqlite connection with the dwpa schema applied."""
+    """One sqlite connection with the dwpa schema applied.
+
+    Thread-safe at statement granularity: a process-wide RLock serializes
+    every q/q1/x, so the threaded WSGI server and the --with-jobs cron
+    thread can share one handle.  This is the same coarse posture as the
+    reference (MySQL serializes statements; the only larger critical
+    section it needs is the get_work mutex, which ServerCore provides).
+    """
 
     def __init__(self, path: str = ":memory:"):
         self.path = path
+        self._lock = threading.RLock()
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.row_factory = sqlite3.Row
         self.conn.execute("PRAGMA journal_mode=WAL")
@@ -169,15 +180,18 @@ class Database:
     # -- tiny helpers ------------------------------------------------------
 
     def q(self, sql, params=()):
-        return self.conn.execute(sql, params).fetchall()
+        with self._lock:
+            return self.conn.execute(sql, params).fetchall()
 
     def q1(self, sql, params=()):
-        return self.conn.execute(sql, params).fetchone()
+        with self._lock:
+            return self.conn.execute(sql, params).fetchone()
 
     def x(self, sql, params=()):
-        cur = self.conn.execute(sql, params)
-        self.conn.commit()
-        return cur
+        with self._lock:
+            cur = self.conn.execute(sql, params)
+            self.conn.commit()
+            return cur
 
     def set_stat(self, name: str, value: int):
         self.x("INSERT OR REPLACE INTO stats(name, value) VALUES (?, ?)", (name, value))

@@ -393,3 +393,52 @@ def test_eapol_descriptor_type_gate():
     assert _parse_eapol_key(b"\xaa" * 6, b"\xbb" * 6, bytes(body)) is None
     body[4] = 2
     assert _parse_eapol_key(b"\xaa" * 6, b"\xbb" * 6, bytes(body)) is not None
+
+
+def test_concurrent_get_work_never_double_issues():
+    """N threads hammering get_work: every unit gets a distinct hkey and
+    no (net, dict) lease is issued twice — the get_work.php:49 SHM-mutex
+    semantics under the threaded server."""
+    import threading
+
+    core = ServerCore(Database(":memory:"))
+    for i in range(6):
+        core.add_hashlines(
+            [tfx.make_pmkid_line(b"ccpass%03d" % i, b"CcNet%d" % i,
+                                 seed=f"cc{i}")])
+    core.db.x("UPDATE nets SET algo = ''")
+    for i in range(6):
+        core.add_dict(f"dict/cc{i}.txt.gz", f"cc{i}", "0" * 32, 10 + i)
+
+    works, errs = [], []
+
+    def worker():
+        try:
+            for _ in range(4):
+                w = core.get_work(2)
+                if w:
+                    works.append(w)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    hkeys = [w["hkey"] for w in works]
+    assert len(hkeys) == len(set(hkeys))  # unique unit ids
+    # The real double-issue symptom: a unit whose OR-IGNOREd lease rows
+    # were clobbered by a racing unit — its hkey then owns fewer rows
+    # than hashes x dicts.  Every returned unit must own exactly its
+    # claimed coverage, and the global row count must add up.
+    total = 0
+    for w in works:
+        expect = len(w["hashes"]) * len(w["dicts"])
+        owned = core.db.q1(
+            "SELECT COUNT(*) c FROM n2d WHERE hkey = ?", (w["hkey"],)
+        )["c"]
+        assert owned == expect, (w["hkey"], owned, expect)
+        total += expect
+    assert core.db.q1("SELECT COUNT(*) c FROM n2d")["c"] == total
