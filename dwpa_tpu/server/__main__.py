@@ -62,9 +62,26 @@ def cmd_serve(args):
         """One thread per request, like the reference under Apache
         prefork: a slow capture upload must not block get_work for the
         whole fleet.  Database serializes statements; get_work holds the
-        scheduler mutex (core.py)."""
+        scheduler mutex (core.py).  Concurrent request handling is
+        capped (Apache's MaxClients analog) so N hostile uploads cannot
+        hold N x 64 MiB request bodies in memory at once — excess
+        connections queue on the semaphore.
+        """
 
         daemon_threads = True
+        max_concurrent = 16
+
+        def process_request_thread(self, request, client_address):
+            with self._request_slots:
+                super().process_request_thread(request, client_address)
+
+        def server_activate(self):
+            import threading
+
+            self._request_slots = threading.BoundedSemaphore(
+                self.max_concurrent
+            )
+            super().server_activate()
 
     app = make_wsgi_app(_core(args))
     if getattr(args, "with_jobs", False):

@@ -73,11 +73,14 @@ class ServerCore:
         self.bosskey = bosskey        # 32-hex superuser key (conf.php)
         self.captcha = captcha        # callable(response, ip) -> bool, or None
         self.base_url = base_url      # public URL for mailed links
-        # Global mutex around the work-unit issue critical section, the
+        # Global mutex around the scheduler's shared state, the
         # reference's SHM lockfile (create_lock('get_work.lock'),
-        # get_work.php:49): without it two concurrent volunteers could
-        # select the same target net before either records its leases.
-        self._getwork_lock = threading.Lock()
+        # get_work.php:49): get_work's target-select + lease-record must
+        # be atomic vs other volunteers AND vs the n2d-mutating crack
+        # paths (_mark_cracked/_delete_net), or a concurrent accept
+        # could interleave with the lease inserts and orphan rows for a
+        # cracked net.  RLock: accept paths may nest.
+        self._getwork_lock = threading.RLock()
 
     # ------------------------------------------------------------------
     # Ingestion
@@ -389,20 +392,24 @@ class ServerCore:
         return True
 
     def _mark_cracked(self, net_id: int, psk: bytes, pmk: bytes, nc: int, endian: str):
-        self.db.x(
-            """UPDATE nets SET pass = ?, pmk = ?, nc = ?, endian = ?,
-                              n_state = 1, ts = ? WHERE net_id = ?""",
-            (psk, pmk, nc, endian, now(), net_id),
-        )
-        self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
+        # under the scheduler mutex: the n2d delete must not interleave
+        # with a get_work lease loop for the same net (see __init__)
+        with self._getwork_lock:
+            self.db.x(
+                """UPDATE nets SET pass = ?, pmk = ?, nc = ?, endian = ?,
+                                  n_state = 1, ts = ? WHERE net_id = ?""",
+                (psk, pmk, nc, endian, now(), net_id),
+            )
+            self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
 
     def _delete_net(self, net_id: int):
-        row = self.db.q1("SELECT bssid FROM nets WHERE net_id = ?", (net_id,))
-        self.db.x("DELETE FROM nets WHERE net_id = ?", (net_id,))
-        if row and not self.db.q1(
-            "SELECT 1 FROM nets WHERE bssid = ? LIMIT 1", (row["bssid"],)
-        ):
-            self.db.x("DELETE FROM bssids WHERE bssid = ?", (row["bssid"],))
+        with self._getwork_lock:
+            row = self.db.q1("SELECT bssid FROM nets WHERE net_id = ?", (net_id,))
+            self.db.x("DELETE FROM nets WHERE net_id = ?", (net_id,))
+            if row and not self.db.q1(
+                "SELECT 1 FROM nets WHERE bssid = ? LIMIT 1", (row["bssid"],)
+            ):
+                self.db.x("DELETE FROM bssids WHERE bssid = ?", (row["bssid"],))
 
     # ------------------------------------------------------------------
     # Users & potfile export

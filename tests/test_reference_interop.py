@@ -1,0 +1,99 @@
+"""Wire-protocol interop with the UNMODIFIED reference client.
+
+Loads the real ``help_crack.py`` from the read-only reference checkout
+(skipped when absent) and drives its own ``get_work`` / ``prepare_work``
+/ ``put_work`` against our WSGI server over a live socket — proving the
+README's claim that this server accepts stock volunteers, with the
+reference's code as the contract instead of our reimplementation of it.
+"""
+
+import gzip
+import hashlib
+import importlib.util
+import json
+import os
+import sys
+import threading
+import wsgiref.simple_server
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+HC_PATH = "/root/reference/help_crack/help_crack.py"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(HC_PATH), reason="reference checkout not present"
+)
+
+PSK = b"interop-psk99"
+ESSID = b"InteropNet"
+
+
+def _load_reference_client():
+    spec = importlib.util.spec_from_file_location("help_crack_ref", HC_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    argv = sys.argv
+    sys.argv = ["help_crack.py"]
+    try:
+        spec.loader.exec_module(mod)
+    except SystemExit:
+        pass
+    finally:
+        sys.argv = argv
+    return mod
+
+
+@pytest.fixture
+def live_server(tmp_path):
+    core = ServerCore(Database(":memory:"), dictdir=str(tmp_path / "dicts"))
+    core.add_hashlines(
+        [tfx.make_pmkid_line(PSK, ESSID, seed="io1"),
+         tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="io2")])
+    core.db.x("UPDATE nets SET algo = ''")
+    os.makedirs(core.dictdir, exist_ok=True)
+    blob = gzip.compress(b"notit-0001\n" + PSK + b"\n")
+    with open(os.path.join(core.dictdir, "io.txt.gz"), "wb") as f:
+        f.write(blob)
+    core.add_dict("dict/io.txt.gz", "io.txt.gz",
+                  hashlib.md5(blob).hexdigest(), 2)
+    srv = wsgiref.simple_server.make_server(
+        "127.0.0.1", 0, make_wsgi_app(core))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield core, f"http://127.0.0.1:{srv.server_port}/"
+    srv.shutdown()
+
+
+def test_reference_client_full_unit(live_server, tmp_path, monkeypatch):
+    core, base = live_server
+    hc = _load_reference_client()
+    hc.conf["base_url"] = base
+    for key in ("get_work_url", "put_work_url", "prdict_url"):
+        hc.conf[key] = base + "?" + key.split("_url")[0]
+    hc.conf["format"] = "22000"  # what its hashcat probe would select
+    monkeypatch.chdir(tmp_path)
+
+    client = hc.HelpCrack(c=hc.conf)
+    work = client.get_work(2)
+    assert isinstance(work, dict) and {"hkey", "dicts", "hashes"} <= set(work)
+    assert len(work["hashes"]) == 2  # same-ESSID grouping, like get_work.php
+
+    # the reference client writes its own hash file from our payload
+    client.prepare_work(work)
+    lines = open("help_crack.hash").read().splitlines()
+    assert len(lines) == 2 and all(ln.startswith("WPA*") for ln in lines)
+
+    # the reference's dict download path verifies our md5 manifest
+    d = work["dicts"][0]
+    assert client.download(base + d["dpath"], "io.txt.gz")
+    assert client.md5file("io.txt.gz") == d["dhash"]
+
+    # submit the crack through the reference's own put_work
+    mac_ap = work["hashes"][0].split("*")[3]
+    client.put_work([{"k": mac_ap, "v": PSK.hex()}], work["hkey"])
+    rows = core.db.q("SELECT n_state, pass FROM nets")
+    assert all(r["n_state"] == 1 and r["pass"] == PSK for r in rows)
+    assert core.db.q1(
+        "SELECT COUNT(*) c FROM n2d WHERE hkey IS NOT NULL")["c"] == 0
