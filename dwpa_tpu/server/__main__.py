@@ -70,10 +70,28 @@ def cmd_serve(args):
 
         daemon_threads = True
         max_concurrent = 16
+        request_timeout = 120.0  # reference client's socket timeout
+
+        def process_request(self, request, client_address):
+            # Acquire in the accept loop, BEFORE spawning the handler
+            # thread: resources (threads, fds, bodies) are bounded at
+            # the accept layer; excess connections wait in the kernel
+            # listen backlog, exactly like Apache at MaxClients.
+            self._request_slots.acquire()
+            try:
+                super().process_request(request, client_address)
+            except Exception:
+                self._request_slots.release()
+                raise
 
         def process_request_thread(self, request, client_address):
-            with self._request_slots:
+            try:
+                # An idle/stalled peer must not hold its slot forever —
+                # reads time out, the handler errors, the slot frees.
+                request.settimeout(self.request_timeout)
                 super().process_request_thread(request, client_address)
+            finally:
+                self._request_slots.release()
 
         def server_activate(self):
             import threading

@@ -47,6 +47,13 @@ def gen_key() -> str:
 
 VALID_KEY_RE = re.compile(r"^[a-f0-9]{32}$")
 
+#: scheduler locks keyed by absolute DB path: every ServerCore over the
+#: same file database (e.g. the serving core and the --with-jobs cron
+#: core) must share ONE mutex, or their n2d mutations could interleave
+#: across connections.  :memory: handles are distinct databases, so each
+#: gets its own lock.
+_SCHED_LOCKS = {}
+
 
 def valid_key(key: str) -> bool:
     """32 lowercase-hex chars (web/index.php:105-107)."""
@@ -79,8 +86,14 @@ class ServerCore:
         # be atomic vs other volunteers AND vs the n2d-mutating crack
         # paths (_mark_cracked/_delete_net), or a concurrent accept
         # could interleave with the lease inserts and orphan rows for a
-        # cracked net.  RLock: accept paths may nest.
-        self._getwork_lock = threading.RLock()
+        # cracked net.  RLock: accept paths may nest.  Shared across
+        # every core on the same file DB (see _SCHED_LOCKS).
+        if db.path == ":memory:":
+            self._getwork_lock = threading.RLock()
+        else:
+            self._getwork_lock = _SCHED_LOCKS.setdefault(
+                os.path.abspath(db.path), threading.RLock()
+            )
 
     # ------------------------------------------------------------------
     # Ingestion

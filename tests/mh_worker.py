@@ -1,0 +1,56 @@
+"""Subprocess worker for the multi-host mesh test (not collected by
+pytest).  Forces the virtual CPU platform (the container pre-imports
+jax, so env vars alone don't take — jax.config must be updated), joins
+the two-process jax.distributed cluster, and runs the sharded crack
+step over the global 8-device mesh with this host's candidate shard."""
+
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from dwpa_tpu import testing as tfx
+    from dwpa_tpu.models import hashline as hl
+    from dwpa_tpu.models import m22000 as m
+    from dwpa_tpu.parallel import build_crack_step
+    from dwpa_tpu.parallel.mesh import multihost_mesh, shard_candidates
+    from dwpa_tpu.utils import bytesops as bo
+
+    mesh = multihost_mesh(coordinator=f"localhost:{port}",
+                          num_processes=2, process_id=pid)
+    # device count per process follows the caller's XLA_FLAGS (4 when
+    # run standalone, 8 under the pytest env) — the mesh must span both
+    # processes' devices either way
+    assert mesh.size == 2 * jax.local_device_count(), mesh
+    psk, essid = b"multihost99", b"MhNet"
+    nets = [m.prep_net(hl.parse(tfx.make_pmkid_line(psk, essid, seed="mh")))]
+    s1, s2 = m.essid_salt_blocks(essid)
+    step = build_crack_step(mesh, nets, s1, s2)
+    # Global batch of 16; the planted PSK lives in process 1's half, so
+    # a hit on every process proves the cross-host psum.
+    batch = 2 * mesh.size
+    words = [b"mh-word%04d" % i for i in range(batch)]
+    words[batch // 2 + 3] = psk  # in process 1's half
+    local = words[pid * (batch // 2):(pid + 1) * (batch // 2)]
+    pw = shard_candidates(mesh, bo.pack_passwords_be(local))
+    hits, found, _ = step(pw)
+    print(f"RESULT {pid} hits={int(np.asarray(hits))}", flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""True multi-process mesh validation (SURVEY §5.8).
+
+Launches two worker processes that join one jax.distributed cluster
+(4 virtual CPU devices each -> an 8-device global dp mesh — standing in
+for two TPU hosts of one slice), each feeding its host-local candidate
+shard through ``shard_candidates``'s multi-process branch.  The planted
+PSK lives on process 1, so process 0 only sees the hit through the
+cross-host psum — the collective the whole multi-host design rides on.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "mh_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_crack_step():
+    port = str(_free_port())
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(pid), port],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=240) for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [(p.returncode, o[1][-800:]) for p, o in zip(procs, outs)]
+    outs = [o[0] for o in outs]
+    for pid, out in enumerate(outs):
+        assert f"RESULT {pid} hits=1" in out, (pid, out)

@@ -64,6 +64,7 @@ def live_server(tmp_path):
     t.start()
     yield core, f"http://127.0.0.1:{srv.server_port}/"
     srv.shutdown()
+    srv.server_close()
 
 
 def test_reference_client_full_unit(live_server, tmp_path, monkeypatch):
@@ -73,6 +74,13 @@ def test_reference_client_full_unit(live_server, tmp_path, monkeypatch):
     for key in ("get_work_url", "put_work_url", "prdict_url"):
         hc.conf[key] = base + "?" + key.split("_url")[0]
     hc.conf["format"] = "22000"  # what its hashcat probe would select
+    # The reference client retries forever (sleepy(123)) on "No nets" or
+    # malformed responses; a server regression must FAIL the test, not
+    # wedge the suite.
+    def fail_fast(self, sec=None):
+        raise AssertionError("reference client entered its retry loop — "
+                             "the server returned No nets/garbage")
+    monkeypatch.setattr(hc.HelpCrack, "sleepy", fail_fast)
     monkeypatch.chdir(tmp_path)
 
     client = hc.HelpCrack(c=hc.conf)
