@@ -212,3 +212,32 @@ def test_no_retransmission_no_hint():
         h = hl.parse(line)
         if h.hash_type == hl.TYPE_EAPOL:
             assert not h.message_pair & (hl.MP_LE | hl.MP_BE)
+
+
+def test_parser_survives_garbage_and_mutations():
+    """Ingestion is an open endpoint: random blobs and bit-flipped valid
+    captures must parse to (possibly empty) results, never raise."""
+    import random
+
+    rng = random.Random(0xFEED)
+    cap = tfx.pcap_bytes(FRAMES)
+    blobs = [bytes(rng.randrange(256) for _ in range(n))
+             for n in (0, 1, 7, 64, 300)]
+    for _ in range(40):
+        mut = bytearray(cap)
+        for _ in range(rng.randrange(1, 8)):
+            mut[rng.randrange(len(mut))] ^= 1 << rng.randrange(8)
+        blobs.append(bytes(mut))
+    for i in range(12):
+        cut = rng.randrange(len(cap))
+        blobs.append(cap[:cut])                      # truncations
+        blobs.append(cap + cap[:cut])                # trailing junk
+    for blob in blobs:
+        try:
+            lines, probes = extract_hashlines(blob)
+        except ValueError:
+            lines = []  # "not a capture" is the endpoint's 400 contract
+        for ln in lines:
+            hl.parse(ln)                             # anything emitted parses
+    # (the native parser gets the same blobs differentially in
+    # tests/test_native_capture.py's fuzz loops)

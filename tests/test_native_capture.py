@@ -7,6 +7,7 @@ import shutil
 import pytest
 
 from dwpa_tpu import testing as tfx
+from dwpa_tpu.models import hashline as hl
 from dwpa_tpu.server.capture import extract_hashlines
 
 native = pytest.importorskip("dwpa_tpu.native")
@@ -22,6 +23,8 @@ def _diff(blob, nc_hint=True):
     fast = native.extract_hashlines_fast(blob, nc_hint=nc_hint)
     py = extract_hashlines(blob, nc_hint=nc_hint)
     assert fast == py
+    for ln in py[0]:
+        hl.parse(ln)  # anything either parser emits must be a valid line
     return py
 
 
