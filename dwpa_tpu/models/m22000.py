@@ -507,10 +507,92 @@ class M22000Engine:
         self.stage_times["dispatch"] += time.perf_counter() - t0
         return pws, nvalid, outs
 
+    #: Per-host cap on hit columns exchanged in one multi-process batch
+    #: (a fixed-size allgather keeps the exchange shape static; real
+    #: crack batches see hits at ~1e-6 rates, so 128 is generous).
+    MAX_FINDS_PER_BATCH = 128
+
+    def _replicated(self, x):
+        """Reshard a batch-sharded step output to fully replicated.
+
+        On a multi-process mesh the raw outputs live partly on
+        non-addressable devices, which ``np.asarray`` rejects; this jitted
+        identity with a replicated out-sharding compiles to an all_gather
+        that every process enters in lockstep (the psum hits-gate already
+        agreed the batch has a hit, so control flow cannot diverge).
+        One jit object per engine so only the first find per shape pays a
+        compilation."""
+        fn = getattr(self, "_replicate_jit", None)
+        if fn is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            fn = jax.jit(
+                lambda a: a,
+                out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+            )
+            self._replicate_jit = fn
+        return fn(x)
+
+    def _gather_find_data(self, found_dev, pmk_dev, pws, nvalid):
+        """Multi-process hit decode (rare path).
+
+        Returns ``(found, pmk_host, psk_by_col)``: the replicated find
+        matrix/PMKs with every host's local padding columns masked, plus
+        a global-column -> candidate-bytes map assembled by a fixed-size
+        allgather — the candidate bytes exist only on the host that fed
+        that shard (shard_candidates' process-local contract), while
+        every host must decode identical founds so the engine's pruning
+        (and the later compiled-step dispatch) stays in SPMD lockstep.
+        """
+        from jax.experimental import multihost_utils
+
+        found = np.array(self._replicated(found_dev))
+        pmk_host = np.asarray(self._replicated(pmk_dev))
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        tgt = found.shape[2] // nproc  # equal local batches (see _prepare)
+        nvalids = np.asarray(
+            multihost_utils.process_allgather(np.array([nvalid]))
+        ).reshape(-1)
+        for p in range(nproc):
+            found[:, :, p * tgt + int(nvalids[p]):(p + 1) * tgt] = False
+        # Fixed-shape candidate exchange: [used(1) col(4) len(1) psk(63)]
+        # rows, MAX_FINDS_PER_BATCH per round.  Every host derives every
+        # host's owned-hit count from the (replicated) find matrix, so
+        # all agree on the round count with no extra collective — and no
+        # hit is ever dropped, however dense the batch.
+        hit_cols = [int(b) for b in np.flatnonzero(found.any(axis=(0, 1)))]
+        owned = {p: [b for b in hit_cols if b // tgt == p]
+                 for p in range(nproc)}
+        rounds = max(
+            1, -(-max(len(c) for c in owned.values()) // self.MAX_FINDS_PER_BATCH)
+        )
+        mine = owned[pid]
+        psk_by_col = {}
+        for r in range(rounds):
+            ex = np.zeros((self.MAX_FINDS_PER_BATCH, 6 + MAX_PSK_LEN), np.uint8)
+            chunk = mine[r * self.MAX_FINDS_PER_BATCH:
+                         (r + 1) * self.MAX_FINDS_PER_BATCH]
+            for k, b in enumerate(chunk):
+                psk = pws[b - pid * tgt]
+                ex[k, 0] = 1
+                ex[k, 1:5] = np.frombuffer(struct.pack("<I", b), np.uint8)
+                ex[k, 5] = len(psk)
+                ex[k, 6:6 + len(psk)] = np.frombuffer(psk, np.uint8)
+            allex = np.asarray(multihost_utils.process_allgather(ex))
+            allex = allex.reshape(-1, ex.shape[1])
+            psk_by_col.update({
+                int(struct.unpack("<I", row[1:5].tobytes())[0]):
+                    row[6:6 + int(row[5])].tobytes()
+                for row in allex if row[0]
+            })
+        return found, pmk_host, psk_by_col
+
     def _collect(self, dispatched) -> list:
         """Sync stage: gate on hits, decode founds, prune cracked nets."""
         t0 = time.perf_counter()
         pws, nvalid, outs = dispatched
+        multiproc = jax.process_count() > 1
         founds = []
         live = {id(n.line) for g in self.groups.values() for n in g}
         for group, (hits, found_dev, pmk_dev) in outs:
@@ -519,27 +601,39 @@ class M22000Engine:
             # batch; the [N, V, B] matrix and PMKs stay on device.
             if int(np.asarray(hits)) == 0:
                 continue
-            found = np.array(found_dev)  # [N, V_max, B] (host copy, writable)
-            found[:, :, nvalid:] = False
-            pmk_host = np.asarray(pmk_dev)
+            if multiproc:
+                found, pmk_host, psk_by_col = self._gather_find_data(
+                    found_dev, pmk_dev, pws, nvalid
+                )
+            else:
+                found = np.array(found_dev)  # [N, V_max, B] (host copy)
+                found[:, :, nvalid:] = False
+                pmk_host = np.asarray(pmk_dev)
+                psk_by_col = None
             for ni, net in enumerate(group):
                 if id(net.line) not in live:
                     continue  # already cracked; the step still computes it
                 nf = found[ni]  # [V_max, B]
                 hit_cols = np.flatnonzero(nf.any(axis=0))
                 for b in hit_cols:
+                    if psk_by_col is None:
+                        psk = pws[b]
+                    else:
+                        psk = psk_by_col.get(int(b))
+                        if psk is None:
+                            continue  # defensive: every hit col is exchanged
                     delta, endian = (0, None)
                     if net.keyver != 100:
                         delta, endian = net.variants[int(nf[:, b].argmax())]
                     pmk_bytes = bo.words_to_bytes_be(pmk_host[:, b])
                     if self.verify_with_oracle:
-                        chk = oracle.check_key_m22000(net.line, [pws[b]], nc=self.nc)
+                        chk = oracle.check_key_m22000(net.line, [psk], nc=self.nc)
                         if chk is None:
                             continue  # device false positive: reject like the server would
                     founds.append(
                         Found(
                             line=net.line,
-                            psk=pws[b],
+                            psk=psk,
                             nc=delta,
                             endian=endian or "",
                             pmk=pmk_bytes,

@@ -68,6 +68,30 @@ def hmac_sha1_20(istate, ostate, m5, compress=sha1_compress):
     return _outer_sha1(ostate, inner, compress)
 
 
+def hmac_sha1_20_prologue(istate, ostate):
+    """Hoist the per-candidate loop-invariant work of ``hmac_sha1_20``.
+
+    Run once per candidate outside the PBKDF2 loop; the returned pair
+    feeds :func:`hmac_sha1_20_hoisted` for all 4096 iterations.
+    """
+    from .sha1 import sha1_20_prologue
+
+    return (sha1_20_prologue(istate), sha1_20_prologue(ostate))
+
+
+def hmac_sha1_20_hoisted(pro, m5):
+    """HMAC-SHA1 of a 20-byte message from hoisted pad-state prologues.
+
+    Bit-identical to ``hmac_sha1_20`` (both compressions hash a 20-byte
+    message: the PBKDF2 U word and the inner digest are each 5 words).
+    """
+    from .sha1 import sha1_compress_20
+
+    ipro, opro = pro
+    inner = sha1_compress_20(ipro, m5)
+    return sha1_compress_20(opro, inner)
+
+
 def hmac_sha1_blocks(istate, ostate, msg_blocks, compress=sha1_compress):
     """HMAC-SHA1 over pre-padded message blocks (after the key block)."""
     st = istate

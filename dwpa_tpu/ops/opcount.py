@@ -1,0 +1,137 @@
+"""Census of traced vector ops for the crypto kernels.
+
+The round-2 ceiling analysis estimated ~1,400 uint32 vector ops per SHA-1
+compression by hand.  This module replaces the estimate with a measured
+count: trace the exact function the Pallas PBKDF2 loop body runs
+(``hmac_sha1_20`` + the accumulator xors) and count the integer ALU
+primitives in the jaxpr.  Mosaic lowers each elementwise uint32 primitive
+on a (TILE, 128) tile to TILE/8 VPU vreg ops, so
+
+    element_ops / PMK = 2 lanes x 4095 iterations x eqn_count
+
+is the exact numerator for the kernel-efficiency ratio against the
+measured VPU ceiling (see ops/vpu_probe.py).
+
+Reference cost model: PBKDF2-HMAC-SHA1 x 4096, 32-byte PMK
+(web/common.php:179) = 2 output blocks x 4096 iterations x 2 compressions.
+"""
+
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+
+# Primitives that lower to one VPU ALU op per element.
+ALU_PRIMS = {
+    "add",
+    "sub",
+    "mul",
+    "xor",
+    "and",
+    "or",
+    "not",
+    "shift_left",
+    "shift_right_logical",
+    "shift_right_arithmetic",
+}
+# Shape/dtype plumbing XLA elides or folds; counted separately for audit.
+FREE_PRIMS = {"convert_element_type", "broadcast_in_dim", "reshape", "squeeze"}
+
+
+def _sub_jaxprs(params):
+    for v in params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            inner = getattr(item, "jaxpr", item)
+            if hasattr(inner, "eqns"):
+                yield inner
+
+
+def census(fn, *args):
+    """Trace ``fn(*args)`` and return a Counter of primitive names,
+    descending into nested jaxprs (pjit/scan/while bodies)."""
+    closed = jax.make_jaxpr(fn)(*args)
+    counts = Counter()
+    stack = [closed.jaxpr]
+    while stack:
+        jx = stack.pop()
+        for eqn in jx.eqns:
+            subs = list(_sub_jaxprs(eqn.params))
+            if subs:
+                stack.extend(subs)
+            else:
+                counts[eqn.primitive.name] += 1
+    return counts
+
+
+def summarize(counts):
+    alu = sum(n for p, n in counts.items() if p in ALU_PRIMS)
+    free = sum(n for p, n in counts.items() if p in FREE_PRIMS)
+    other = sum(
+        n for p, n in counts.items() if p not in ALU_PRIMS and p not in FREE_PRIMS
+    )
+    return {
+        "alu_ops": alu,
+        "free_ops": free,
+        "other_ops": other,
+        "by_prim": dict(sorted(counts.items(), key=lambda kv: -kv[1])),
+    }
+
+
+def pbkdf2_iteration_census(hoisted=True):
+    """Op census of one PBKDF2 loop-body iteration (per lane): one
+    HMAC-SHA1 of a 20-byte message plus the 5 accumulator xors."""
+    from . import hmac as hm
+    from . import sha1
+
+    z = jnp.zeros((1,), jnp.uint32)
+    st5 = tuple(z for _ in range(5))
+
+    if hoisted:
+        pro = sha1.sha1_20_prologue(st5)
+
+        def body(ipro, opro, u, acc):
+            nu = hm.hmac_sha1_20_hoisted((ipro, opro), u)
+            return tuple(nu) + tuple(a ^ x for a, x in zip(acc, nu))
+
+        counts = census(body, pro, pro, st5, st5)
+    else:
+
+        def body(ist, ost, u, acc):
+            nu = hm.hmac_sha1_20(ist, ost, u)
+            return tuple(nu) + tuple(a ^ x for a, x in zip(acc, nu))
+
+        counts = census(body, st5, st5, st5, st5)
+    return summarize(counts)
+
+
+def sha1_compress_census():
+    """Op census of one generic SHA-1 compression (all 16 words traced)."""
+    from .sha1 import sha1_compress
+
+    z = jnp.zeros((1,), jnp.uint32)
+    st5 = tuple(z for _ in range(5))
+    blk = [z] * 16
+    return summarize(census(lambda s, b: sha1_compress(s, b), st5, blk))
+
+
+def main():
+    import json
+
+    gen = sha1_compress_census()
+    it_plain = pbkdf2_iteration_census(hoisted=False)
+    it_hoist = pbkdf2_iteration_census(hoisted=True)
+    out = {
+        "sha1_compress_generic": gen,
+        "pbkdf2_iter_plain": it_plain,
+        "pbkdf2_iter_hoisted": it_hoist,
+        # 2 lanes (T1/T2) x 4095 loop iterations, plus the 5-compression
+        # prologue (~counted separately; <0.1% of total).
+        "element_ops_per_pmk_plain": 2 * 4095 * it_plain["alu_ops"],
+        "element_ops_per_pmk_hoisted": 2 * 4095 * it_hoist["alu_ops"],
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

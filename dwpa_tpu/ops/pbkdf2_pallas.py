@@ -29,14 +29,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .hmac import hmac_sha1_20, hmac_sha1_blocks, hmac_sha1_precompute
+from .hmac import (
+    hmac_sha1_20,
+    hmac_sha1_20_hoisted,
+    hmac_sha1_20_prologue,
+    hmac_sha1_blocks,
+    hmac_sha1_precompute,
+)
 
 # Lane-tile sublane count per Pallas program.  (TILE, 128) uint32 words;
-# TILE=64 -> 8 vregs per word -> 8-way independent chains per VPU op.
-DEFAULT_TILE = 64
+# TILE=32 -> 4 vregs per word -> 4-way independent chains per VPU op.
+# Swept on hardware (r3): 32 > 64 > 16 > 128 with the hoisted loop body
+# (237.8k / 234.0k / 213.1k / 185.2k PMK/s at B=128k).
+DEFAULT_TILE = 32
 
 
-def _loop_kernel(iterations, unroll, sin_ref, out_ref):
+def _loop_kernel(iterations, unroll, hoist, sin_ref, out_ref):
     """One batch tile: run iterations 1..4096 of the PBKDF2 xor-chain.
 
     ``sin_ref``: uint32[15, TILE, 128] — rows 0-4 the HMAC ipad state,
@@ -47,11 +55,25 @@ def _loop_kernel(iterations, unroll, sin_ref, out_ref):
     ist = tuple(s[i] for i in range(5))
     ost = tuple(s[5 + i] for i in range(5))
     u1 = tuple(s[10 + i] for i in range(5))
+    if hoist:
+        # Hoist the loop-invariant prefix of both compressions (rounds
+        # 0-4 partials over the fixed pad states) out of the loop: ~48 of
+        # ~2,700 vector ops per iteration move here, run once — at the
+        # cost of 16 extra live words of register pressure (A/B'd on
+        # hardware; see BASELINE.md ceiling table).
+        pro = hmac_sha1_20_prologue(ist, ost)
 
-    def body(_, carry):
-        u, acc = carry[:5], carry[5:]
-        nu = hmac_sha1_20(ist, ost, u)
-        return tuple(nu) + tuple(a ^ x for a, x in zip(acc, nu))
+        def body(_, carry):
+            u, acc = carry[:5], carry[5:]
+            nu = hmac_sha1_20_hoisted(pro, u)
+            return tuple(nu) + tuple(a ^ x for a, x in zip(acc, nu))
+
+    else:
+
+        def body(_, carry):
+            u, acc = carry[:5], carry[5:]
+            nu = hmac_sha1_20(ist, ost, u)
+            return tuple(nu) + tuple(a ^ x for a, x in zip(acc, nu))
 
     fin = jax.lax.fori_loop(1, iterations, body, u1 + u1, unroll=unroll)
     out_ref[:] = jnp.stack(fin[5:])
@@ -59,7 +81,9 @@ def _loop_kernel(iterations, unroll, sin_ref, out_ref):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("iterations", "tile", "unroll", "interpret", "prologue_compress"),
+    static_argnames=(
+        "iterations", "tile", "unroll", "interpret", "prologue_compress", "hoist",
+    ),
 )
 def pbkdf2_sha1_pmk_pallas(
     pw_words,
@@ -71,6 +95,7 @@ def pbkdf2_sha1_pmk_pallas(
     unroll=1,
     interpret=False,
     prologue_compress=None,
+    hoist=True,
 ):
     """Derive 32-byte PMKs for a packed password batch on TPU via Pallas.
 
@@ -82,6 +107,14 @@ def pbkdf2_sha1_pmk_pallas(
     """
     B = pw_words.shape[0]
     pw = [pw_words[:, i] for i in range(16)]
+    # The hoisted loop body is a TPU-only perf feature (+4-6% on chip):
+    # under interpret mode its closure-carried prologue makes the
+    # XLA:CPU lowering pathologically slow (>400 s vs ~28 s measured),
+    # so CPU correctness tests run the generic body; the hoisted math
+    # itself is pinned CPU-side at the sha1 level (tests/test_ops.py
+    # sha1_compress_20 equivalence) and bit-exact vs hashlib on TPU.
+    if interpret:
+        hoist = False
 
     # Cold prologue (5 compressions of the 8192): pad states + U1, XLA-side.
     # ``prologue_compress`` lets CPU callers (tests) use the rolled
@@ -109,7 +142,7 @@ def pbkdf2_sha1_pmk_pallas(
     sin = sin.reshape(15, padded // 128, 128)
 
     out = pl.pallas_call(
-        functools.partial(_loop_kernel, iterations, unroll),
+        functools.partial(_loop_kernel, iterations, unroll, hoist),
         grid=(padded // step,),
         in_specs=[
             pl.BlockSpec((15, tile, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM)

@@ -26,34 +26,33 @@ def sha1_init(shape=()):
     return tuple(jnp.full(shape, v, jnp.uint32) for v in IV)
 
 
-def sha1_compress(state, block):
-    """One SHA-1 compression.
+def _xor(x, y):
+    # Fold xors with integer constants at trace time (the 20-byte HMAC
+    # message block is mostly constant padding words).
+    if isinstance(x, int) and isinstance(y, int):
+        return x ^ y
+    if isinstance(x, int) and x == 0:
+        return y
+    if isinstance(y, int) and y == 0:
+        return x
+    return u32(x) ^ u32(y)
 
-    ``state``: 5-tuple of uint32 arrays.  ``block``: list of 16 uint32
-    arrays (big-endian message words); entries may be Python ints for
-    constant words (e.g. padding) — XLA constant-folds them.
-    Returns the new 5-tuple state.
+
+def _rotl(x, n):
+    if isinstance(x, int):
+        return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+    return rotl32(x, n)
+
+
+def _rounds(state, w, start=0):
+    """SHA-1 rounds ``start``..79 over the (mutated) schedule list ``w``.
+
+    Fully unrolled at trace time; returns the working variables (not yet
+    added back into ``state``).  Round ``t`` reads ``w[t]`` and appends the
+    expanded schedule word for ``t >= 16``; constant-int words fold away.
     """
-    w = list(block)
     a, b, c, d, e = state
-
-    def _xor(x, y):
-        # Fold xors with integer constants at trace time (the 20-byte HMAC
-        # message block is mostly constant padding words).
-        if isinstance(x, int) and isinstance(y, int):
-            return x ^ y
-        if isinstance(x, int) and x == 0:
-            return y
-        if isinstance(y, int) and y == 0:
-            return x
-        return u32(x) ^ u32(y)
-
-    def _rotl(x, n):
-        if isinstance(x, int):
-            return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
-        return rotl32(x, n)
-
-    for t in range(80):
+    for t in range(start, 80):
         if t >= 16:
             w.append(_rotl(_xor(_xor(w[t - 3], w[t - 8]), _xor(w[t - 14], w[t - 16])), 1))
         if t < 20:
@@ -77,7 +76,71 @@ def sha1_compress(state, block):
         c = rotl32(b, 30)
         b = a
         a = tmp
+    return a, b, c, d, e
 
+
+def sha1_compress(state, block):
+    """One SHA-1 compression.
+
+    ``state``: 5-tuple of uint32 arrays.  ``block``: list of 16 uint32
+    arrays (big-endian message words); entries may be Python ints for
+    constant words (e.g. padding) — XLA constant-folds them.
+    Returns the new 5-tuple state.
+    """
+    a, b, c, d, e = _rounds(state, list(block))
+    s0, s1, s2, s3, s4 = state
+    return (s0 + a, s1 + b, s2 + c, s3 + d, s4 + e)
+
+
+def sha1_20_prologue(state):
+    """Hoist the loop-invariant prefix of a 20-byte-message compression.
+
+    In the PBKDF2 hot loop (web/common.php:179 semantics) the HMAC
+    ipad/opad states are fixed per candidate while only the 5 message
+    words change each iteration, so every subexpression of rounds 0-4
+    that depends solely on ``state`` can be computed once outside the
+    4096-iteration loop: f0/f1 in full, the c-rotations of rounds 0-1,
+    and the e+K addends of rounds 2-4 (~24 vector ops per compression,
+    x2 compressions x 8190 iterations per PMK).  Returns an opaque tuple
+    consumed by :func:`sha1_compress_20`.
+    """
+    a, b, c, d, e = state
+    c0r = rotl32(b, 30)  # c after round 0; d at round 2; e at round 3
+    a0r = rotl32(a, 30)  # c after round 1; d at round 3; e at round 4
+    f0 = d ^ (b & (c ^ d))
+    p0 = rotl32(a, 5) + f0 + e + u32(K0)
+    f1 = c ^ (a & (c0r ^ c))
+    p1 = f1 + d + u32(K0)
+    x2 = a0r ^ c0r
+    p2 = c + u32(K0)
+    p3 = c0r + u32(K0)
+    p4 = a0r + u32(K0)
+    return (state, c0r, a0r, p0, p1, x2, p2, p3, p4)
+
+
+def sha1_compress_20(pro, m5):
+    """One compression of a 20-byte message from a hoisted prologue.
+
+    Bit-identical to ``sha1_compress(state, m5 + padding)`` for the
+    fixed PBKDF2/HMAC message shape (20-byte message, 84 bytes total
+    hashed), with rounds 0-4 specialized to reuse the loop-invariant
+    values from :func:`sha1_20_prologue`.
+    """
+    state, c0r, a0r, p0, p1, x2, p2, p3, p4 = pro
+    w0, w1, w2, w3, w4 = (u32(x) for x in m5)
+    t0 = p0 + w0
+    t1 = rotl32(t0, 5) + (p1 + w1)
+    f2 = c0r ^ (t0 & x2)
+    t2 = rotl32(t1, 5) + f2 + (p2 + w2)
+    cv3 = rotl32(t0, 30)
+    f3 = a0r ^ (t1 & (cv3 ^ a0r))
+    t3 = rotl32(t2, 5) + f3 + (p3 + w3)
+    cv4 = rotl32(t1, 30)
+    f4 = cv3 ^ (t2 & (cv4 ^ cv3))
+    t4 = rotl32(t3, 5) + f4 + (p4 + w4)
+    # State entering round 5; schedule words 5..15 are the fixed padding.
+    w = [w0, w1, w2, w3, w4, 0x80000000] + [0] * 9 + [84 * 8]
+    a, b, c, d, e = _rounds((t4, t3, rotl32(t2, 30), cv4, cv3), w, start=5)
     s0, s1, s2, s3, s4 = state
     return (s0 + a, s1 + b, s2 + c, s3 + d, s4 + e)
 

@@ -141,13 +141,14 @@ _POOLS = {}         # parent-side: worker count -> live Pool (reused)
 
 
 def _pool_expand(args):
-    key, texts, chunk = args
-    rules = _WORKER_RULES.get(key)
+    texts, chunk = args
+    # texts ride along with every chunk (~1 KB) so the pool can be
+    # reused across different rule sets; each worker parses a given set
+    # once and caches it, keyed by the texts tuple itself (a hash() key
+    # could collide across rulesets and silently mangle candidates).
+    rules = _WORKER_RULES.get(texts)
     if rules is None:
-        # texts ride along with every chunk (~1 KB) so the pool can be
-        # reused across different rule sets; each worker parses a given
-        # set once and caches it
-        rules = _WORKER_RULES.setdefault(key, [parse_rule(t) for t in texts])
+        rules = _WORKER_RULES.setdefault(texts, [parse_rule(t) for t in texts])
     out = []
     for word in chunk:
         for rule in rules:
@@ -183,7 +184,6 @@ def _apply_rules_pooled(rules, words, workers, chunk_words: int = 2048):
     import itertools
 
     texts = tuple(r.text for r in rules)
-    key = hash(texts)
     it = iter(words)
     chunks = iter(lambda: list(itertools.islice(it, chunk_words)), [])
     pool = _get_pool(workers)
@@ -193,7 +193,7 @@ def _apply_rules_pooled(rules, words, workers, chunk_words: int = 2048):
     # (imap's result cache is unbounded).
     pending = collections.deque()
     for chunk in chunks:
-        pending.append(pool.apply_async(_pool_expand, ((key, texts, chunk),)))
+        pending.append(pool.apply_async(_pool_expand, ((texts, chunk),)))
         if len(pending) > workers + 2:
             yield from pending.popleft().get()
     while pending:

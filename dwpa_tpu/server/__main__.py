@@ -42,7 +42,7 @@ def _core(args):
     _load_conf(args)
     if not getattr(args, "db", None):
         raise SystemExit("--db (or a conf file with a 'db' key) is required")
-    return ServerCore(
+    core = ServerCore(
         Database(args.db),
         dictdir=getattr(args, "dictdir", None) or "dicts",
         capdir=getattr(args, "capdir", None) or "caps",
@@ -50,6 +50,18 @@ def _core(args):
         hcdir=getattr(args, "hcdir", None),
         base_url=getattr(args, "base_url", None) or "",
     )
+    if getattr(args, "recaptcha_secret", None):
+        from .external import RECAPTCHA_URL, RecaptchaVerifier
+
+        core.captcha = RecaptchaVerifier(
+            args.recaptcha_secret,
+            url=getattr(args, "recaptcha_url", None) or RECAPTCHA_URL,
+        )
+    if getattr(args, "mx_check", False):
+        from .external import mx_email_validator
+
+        core.email_check = mx_email_validator()
+    return core
 
 
 def cmd_serve(args):
@@ -148,11 +160,26 @@ def _psk_lookup_from_file(path):
 
 
 def _job_lookups(args):
-    """Build the offline geo/PSK lookup callables — ONCE, and before any
-    background thread starts, so a bad path or malformed file fails the
-    command loudly instead of silently killing the cron layer."""
-    geo = _geo_lookup_from_file(args.geo_file) if args.geo_file else None
-    psk = _psk_lookup_from_file(args.psk_file) if args.psk_file else None
+    """Build the geo/PSK lookup callables — ONCE, and before any
+    background thread starts, so a bad path, malformed file, or missing
+    API key fails the command loudly instead of silently killing the
+    cron layer.  Offline file sources win over live API adapters when
+    both are configured (airgapped deployments stay airgapped)."""
+    geo = psk = None
+    if getattr(args, "wigle_api", None):
+        from .external import WIGLE_URL, WigleClient
+
+        geo = WigleClient(args.wigle_api,
+                          url=getattr(args, "wigle_url", None) or WIGLE_URL)
+    if getattr(args, "wifi3_api", None):
+        from .external import WIFI3_URL, ThreeWifiClient
+
+        psk = ThreeWifiClient(args.wifi3_api,
+                              url=getattr(args, "wifi3_url", None) or WIFI3_URL)
+    if args.geo_file:
+        geo = _geo_lookup_from_file(args.geo_file)
+    if args.psk_file:
+        psk = _psk_lookup_from_file(args.psk_file)
     return geo, psk
 
 
@@ -249,6 +276,12 @@ def cmd_enrich(args):
         enrich_message_pair(_core(args), limit=args.limit, extractor=ex)))
 
 
+def cmd_reorder_captures(args):
+    from .tools import reorder_captures
+
+    print(json.dumps(reorder_captures(_core(args))))
+
+
 def cmd_pack_client(args):
     from .tools import pack_client
 
@@ -302,6 +335,14 @@ def main(argv=None):
                                            "{mac_hex: {lat, lon, ...}}")
         sp.add_argument("--psk-file", help="offline PSK database, lines of "
                                            "mac_hex:psk (3wifi-dump style)")
+        sp.add_argument("--wigle-api", help="wigle.net Basic-auth API key "
+                                            "(live geolocation, wigle.php)")
+        sp.add_argument("--wigle-url", help="override the wigle endpoint "
+                                            "(stub testing)")
+        sp.add_argument("--wifi3-api", help="3wifi API key (live PSK "
+                                            "lookups, 3wifi.php)")
+        sp.add_argument("--wifi3-url", help="override the 3wifi endpoint "
+                                            "(stub testing)")
 
     sp = sub.add_parser("serve", help="run the HTTP API + UI")
     common(sp)
@@ -315,6 +356,14 @@ def main(argv=None):
     sp.add_argument("--with-jobs", action="store_true",
                     help="run the cron layer as a background thread of "
                          "this process (single-process deployment)")
+    sp.add_argument("--recaptcha-secret",
+                    help="enable reCAPTCHA siteverify on key issue "
+                         "(index.php:16-35)")
+    sp.add_argument("--recaptcha-url", help="override the siteverify "
+                                            "endpoint (stub testing)")
+    sp.add_argument("--mx-check", action="store_true",
+                    help="DNS MX probe on e-mail validation "
+                         "(validEmail, common.php:981-992)")
     jobs_flags(sp)
     sp.set_defaults(fn=cmd_serve)
 
@@ -358,6 +407,12 @@ def main(argv=None):
     sp.add_argument("--native", action="store_true",
                     help="use the C++ bulk parser (native/capture_fast)")
     sp.set_defaults(fn=cmd_enrich)
+
+    sp = sub.add_parser("reorder-captures",
+                        help="migrate a flat capture archive to the dated "
+                             "CAP/Y/m/d layout (misc/reorder_by_date.sh)")
+    common(sp)
+    sp.set_defaults(fn=cmd_reorder_captures)
 
     sp = sub.add_parser("pack-client",
                         help="build the hc/ self-update artifacts "

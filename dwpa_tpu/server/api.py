@@ -70,12 +70,61 @@ def _clear_key_cookie():
     return [("Set-Cookie", "key=; Max-Age=0; HttpOnly")]
 
 
+# Capture uploads get a much tighter body cap than the JSON/form routes:
+# the reference runs behind PHP upload limits (typically single-digit MiB),
+# and a 64 MiB cap x 16 concurrent workers would bound worst-case hostile
+# upload memory at 1 GiB.  8 MiB holds any real-world capture.
+CAPTURE_BODY_CAP = 8 * 1024 * 1024
+
+
+def _parse_multipart(body: bytes, ctype: str):
+    """Minimal multipart/form-data parser (RFC 7578 subset) for the
+    browser submit form (web/content/submit.php:18-31 accepts $_FILES).
+
+    Returns ``(fields, files)``: text fields as {name: str} and file
+    parts as {name: (filename, bytes)}.  Strict on structure (missing
+    boundary or malformed part -> ValueError -> 400), tolerant on
+    charset (latin1 headers).
+    """
+    m = re.search(r'boundary="?([^";,\s]+)"?', ctype)
+    if not m:
+        raise ValueError("multipart body without boundary")
+    delim = b"--" + m.group(1).encode("latin1")
+    fields, files = {}, {}
+    chunks = body.split(delim)
+    if len(chunks) < 2:
+        raise ValueError("multipart body without parts")
+    for chunk in chunks[1:]:
+        if chunk[:2] == b"--":
+            break  # closing delimiter
+        head, sep, content = chunk.partition(b"\r\n\r\n")
+        if not sep:
+            raise ValueError("malformed multipart part")
+        if content.endswith(b"\r\n"):
+            content = content[:-2]
+        headers = head.decode("latin1")
+        mname = re.search(r'name="([^"]*)"', headers)
+        if not mname:
+            continue
+        mfile = re.search(r'filename="([^"]*)"', headers)
+        if mfile:
+            files[mname.group(1)] = (mfile.group(1), content)
+        else:
+            fields[mname.group(1)] = content.decode("utf-8", "replace")
+    return fields, files
+
+
 def _read_body(environ, cap=64 * 1024 * 1024) -> bytes:
     # Cached: the UI router may parse the body as a form and fall through
     # to the capture path — re-reading a socket-backed wsgi.input past the
-    # request body would block the worker.
+    # request body would block the worker.  The cap still applies to the
+    # cached body: the capture path's tighter limit must hold even when
+    # an urlencoded route already slurped the body at the default cap.
     if "dwpa.body" in environ:
-        return environ["dwpa.body"]
+        body = environ["dwpa.body"]
+        if len(body) > cap:
+            raise BodyTooLarge(len(body))
+        return body
     try:
         n = int(environ.get("CONTENT_LENGTH") or 0)
     except ValueError:
@@ -161,14 +210,26 @@ def _route(core: ServerCore, environ):
         return resp
 
     if environ["REQUEST_METHOD"] == "POST":
-        # capture submission (multipart not required: raw body accepted,
-        # like the besside-ng direct upload path)
-        blob = _read_body(environ)
+        # Capture submission.  Two wire shapes, one pipeline:
+        # - raw body (the besside-ng direct upload, index.php:4-11);
+        # - multipart/form-data from the browser submit form
+        #   (content/submit.php:18-31) — the capture is the first file
+        #   part (the form names it "file").
+        blob = _read_body(environ, cap=CAPTURE_BODY_CAP)
+        userkey = qs.get("key", [None])[0]
+        ctype = environ.get("CONTENT_TYPE", "")
+        if ctype.startswith("multipart/form-data"):
+            fields, files = _parse_multipart(blob, ctype)
+            part = files.get("file") or next(iter(files.values()), None)
+            if part is None:
+                return "400 Bad Request", "text/plain", b"no file part"
+            blob = part[1]
+            userkey = fields.get("key", userkey)
         if not blob:
             return "400 Bad Request", "text/plain", b"empty submission"
         report = submit_capture(core, blob,
                                 ip=environ.get("REMOTE_ADDR", ""),
-                                userkey=qs.get("key", [None])[0])
+                                userkey=userkey)
         return "200 OK", "application/json", json.dumps(report).encode()
 
     return "200 OK", "text/plain", b"dwpa_tpu server"
@@ -189,6 +250,14 @@ def _route_ui(core: ServerCore, environ, qs):
     from .core import valid_email, valid_key
 
     method = environ["REQUEST_METHOD"]
+    if method == "POST" and environ.get("CONTENT_TYPE", "").startswith(
+        "multipart/form-data"
+    ):
+        # The ?submit form posts its multipart body back to /?submit
+        # (content/submit.php:18-31 handles $_FILES on the same URL);
+        # fall through to the capture-upload handler instead of
+        # re-rendering the page over the discarded body.
+        return None
     form = {}
     if method == "POST" and environ.get("CONTENT_TYPE", "").startswith(
         "application/x-www-form-urlencoded"
@@ -224,7 +293,7 @@ def _route_ui(core: ServerCore, environ, qs):
             return ("200 OK", "text/html",
                     ui.render(ui.page_get_key("Captcha validation failed.")))
         mail = form["mail"].strip()
-        if not valid_email(mail):
+        if not (core.email_check or valid_email)(mail):
             return ("200 OK", "text/html",
                     ui.render(ui.page_get_key("No valid e-mail provided!")))
         status, key = core.issue_user_key(mail, ip=ip)

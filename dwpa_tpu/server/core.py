@@ -29,6 +29,7 @@ import re
 import secrets
 import sqlite3
 import threading
+import time
 
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
@@ -53,6 +54,58 @@ VALID_KEY_RE = re.compile(r"^[a-f0-9]{32}$")
 #: across connections.  :memory: handles are distinct databases, so each
 #: gets its own lock.
 _SCHED_LOCKS = {}
+
+
+class _SchedLock:
+    """Scheduler mutex for file-backed DBs: thread RLock + fcntl flock.
+
+    The reference's lockfile is cross-*process* (create_lock,
+    common.php:320-332) and the documented deployment here runs ``serve``
+    and ``jobs`` as separate processes, so a thread lock alone leaves the
+    n2d lease/delete interleaving unsynchronized between them.  The flock
+    on ``<db>.getwork.lock`` extends the critical section across
+    processes; the RLock keeps it reentrant and thread-safe within one.
+    The OS drops a flock automatically if the holder dies — no 60 s
+    staleness heuristic needed (the reference's TODO at common.php:319
+    asked for exactly this).
+    """
+
+    def __init__(self, db_path: str):
+        self._tl = threading.RLock()
+        self._path = db_path + ".getwork.lock"
+        self._fd = None
+        self._depth = 0  # mutated only while holding _tl
+
+    def __enter__(self):
+        self._tl.acquire()
+        self._depth += 1
+        if self._depth == 1:
+            try:
+                import fcntl
+
+                self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+                fcntl.flock(self._fd, fcntl.LOCK_EX)
+            except BaseException:
+                # A failed open/flock (read-only dir, ENOSPC) must error
+                # this one request, not leave the RLock held forever.
+                self._depth -= 1
+                if self._fd is not None:
+                    os.close(self._fd)
+                    self._fd = None
+                self._tl.release()
+                raise
+        return self
+
+    def __exit__(self, *exc):
+        self._depth -= 1
+        if self._depth == 0 and self._fd is not None:
+            import fcntl
+
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+        self._tl.release()
+        return False
 
 
 def valid_key(key: str) -> bool:
@@ -80,19 +133,23 @@ class ServerCore:
         self.bosskey = bosskey        # 32-hex superuser key (conf.php)
         self.captcha = captcha        # callable(response, ip) -> bool, or None
         self.base_url = base_url      # public URL for mailed links
+        # Optional e-mail validator override (e.g. external.mx_email_validator
+        # adds the reference's DNS MX probe); None -> plain format check.
+        self.email_check = None
         # Global mutex around the scheduler's shared state, the
         # reference's SHM lockfile (create_lock('get_work.lock'),
         # get_work.php:49): get_work's target-select + lease-record must
         # be atomic vs other volunteers AND vs the n2d-mutating crack
         # paths (_mark_cracked/_delete_net), or a concurrent accept
         # could interleave with the lease inserts and orphan rows for a
-        # cracked net.  RLock: accept paths may nest.  Shared across
-        # every core on the same file DB (see _SCHED_LOCKS).
+        # cracked net.  RLock semantics: accept paths may nest.  Shared
+        # across every core on the same file DB (_SCHED_LOCKS) and — via
+        # an fcntl flock — across separate serve/jobs processes.
         if db.path == ":memory:":
             self._getwork_lock = threading.RLock()
         else:
             self._getwork_lock = _SCHED_LOCKS.setdefault(
-                os.path.abspath(db.path), threading.RLock()
+                os.path.abspath(db.path), _SchedLock(os.path.abspath(db.path))
             )
 
     # ------------------------------------------------------------------
@@ -107,8 +164,12 @@ class ServerCore:
             return row["s_id"]
         localfile = None
         if self.capdir:
-            os.makedirs(self.capdir, exist_ok=True)
-            localfile = os.path.join(self.capdir, md5.hex())
+            # Dated archive layout CAP/Y/m/d/<md5> (common.php:492-494,
+            # 507-514); flat legacy dirs migrate via the reorder-captures
+            # CLI (the reference's misc/reorder_by_date.sh).
+            day = time.strftime("%Y/%m/%d")
+            os.makedirs(os.path.join(self.capdir, day), exist_ok=True)
+            localfile = os.path.join(self.capdir, day, md5.hex())
             with open(localfile, "wb") as f:
                 f.write(blob)
         # OR IGNORE + re-select: under the threaded server two identical

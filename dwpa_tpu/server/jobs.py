@@ -229,6 +229,15 @@ def keygen_precompute(core: ServerCore, limit: int = 100,
     return {"processed": len(nets), "cracked": found}
 
 
+class LookupUnavailable(Exception):
+    """Raised by an enrichment ``lookup`` to signal a *transient* failure
+    (network error, service refusal) as opposed to "queried fine, not
+    found".  The batch is abandoned and no row is marked as attempted, so
+    the same BSSIDs are retried next tick — matching the reference's
+    wigle.php, which only stamps ``wiglets`` after a parsed, successful
+    response (wigle.php:33-49)."""
+
+
 def psk_lookup(core: ServerCore, lookup, batch: int = 100) -> dict:
     """External PSK-database sweep (3wifi.php equivalent).
 
@@ -247,7 +256,10 @@ def psk_lookup(core: ServerCore, lookup, batch: int = 100) -> dict:
     macs = [long2mac(r["bssid"]) for r in rows]
     if not macs:
         return {"queried": 0, "submitted": 0}
-    found = lookup(macs) or {}
+    try:
+        found = lookup(macs) or {}
+    except LookupUnavailable:
+        return {"queried": 0, "submitted": 0, "unavailable": True}
     cand = [{"k": mac.hex(), "v": psk.hex()} for mac, psk in found.items()]
     # put_work caps candidates per call (MAX_CANDS_PER_PUT, matching the
     # reference's 200-pair limit) — chunk so no hit is silently dropped.
@@ -274,7 +286,10 @@ def geolocate(core: ServerCore, lookup, batch: int = 5) -> int:
     )
     done = 0
     for r in rows:
-        info = lookup(long2mac(r["bssid"]))
+        try:
+            info = lookup(long2mac(r["bssid"]))
+        except LookupUnavailable:
+            break  # transient outage: leave the rest unmarked for retry
         if info:
             core.db.x(
                 """UPDATE bssids SET lat = ?, lon = ?, country = ?,

@@ -24,6 +24,7 @@ at-least-once ops posture (SURVEY.md §5.2).
 import gzip
 import hashlib
 import os
+import re
 
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
@@ -294,6 +295,40 @@ def migrate_legacy(core: ServerCore, records, ip: str = "",
     if verify:
         recrack_verify(core)
     return {"converted": len(lines), "unconvertible": bad, **res}
+
+
+def reorder_captures(core: ServerCore, capdir: str = None) -> dict:
+    """Migrate a flat capture archive into the dated CAP/Y/m/d layout.
+
+    The reference stores uploads under CAP/Y/m/d (common.php:492-494)
+    and ships misc/reorder_by_date.sh for legacy flat dirs; this is that
+    tool: every md5-named file directly under ``capdir`` moves to
+    ``Y/m/d`` of its mtime, and matching ``submissions.localfile`` rows
+    are rewritten.  Idempotent; files already in dated subdirs are left
+    alone.
+    """
+    import shutil
+    import time as _t
+
+    capdir = capdir or core.capdir
+    if not capdir or not os.path.isdir(capdir):
+        return {"moved": 0, "db_updated": 0}
+    moved = updated = 0
+    for name in sorted(os.listdir(capdir)):
+        src = os.path.join(capdir, name)
+        if not os.path.isfile(src) or not re.fullmatch(r"[0-9a-f]{32}", name):
+            continue
+        day = _t.strftime("%Y/%m/%d", _t.localtime(os.path.getmtime(src)))
+        dstdir = os.path.join(capdir, day)
+        os.makedirs(dstdir, exist_ok=True)
+        dst = os.path.join(dstdir, name)
+        shutil.move(src, dst)
+        moved += 1
+        updated += core.db.x(
+            "UPDATE submissions SET localfile = ? WHERE localfile = ?",
+            (dst, src),
+        ).rowcount
+    return {"moved": moved, "db_updated": updated}
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,25 @@ def main():
     pw = shard_candidates(mesh, bo.pack_passwords_be(local))
     hits, found, _ = step(pw)
     print(f"RESULT {pid} hits={int(np.asarray(hits))}", flush=True)
+
+    # Full-engine find decode across hosts (ADVICE r2 medium): the
+    # planted PSK again lives in process 1's shard, so process 0 can
+    # only produce the Found via the replicated-gather + candidate
+    # exchange in M22000Engine._gather_find_data — and both hosts must
+    # decode the identical find to keep their engines in lockstep.
+    eng = m.M22000Engine(
+        [tfx.make_pmkid_line(psk, essid, seed="mh-eng")],
+        mesh=mesh, batch_size=mesh.size,
+    )
+    batch2 = 2 * mesh.size
+    words2 = [b"ng-word%04d" % i for i in range(batch2)]
+    words2[batch2 // 2 + 1] = psk  # process 1's half
+    local2 = words2[pid * (batch2 // 2):(pid + 1) * (batch2 // 2)]
+    finds = eng.crack_batch(local2)
+    got = finds[0].psk.decode() if finds else "NONE"
+    pruned = len(eng.nets) == 0
+    print(f"ENGINE {pid} finds={len(finds)} psk={got} pruned={pruned}",
+          flush=True)
     jax.distributed.shutdown()
 
 

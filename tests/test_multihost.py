@@ -39,3 +39,8 @@ def test_two_process_mesh_crack_step():
     outs = [o[0] for o in outs]
     for pid, out in enumerate(outs):
         assert f"RESULT {pid} hits=1" in out, (pid, out)
+        # the planted find decodes on BOTH hosts — including process 0,
+        # which never held the candidate bytes (ADVICE r2: the find path
+        # must work when the hit lives on a non-addressable shard)
+        assert f"ENGINE {pid} finds=1 psk=multihost99 pruned=True" in out, \
+            (pid, out)

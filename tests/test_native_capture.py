@@ -105,18 +105,24 @@ def test_garbage_input():
 
 def test_bulk_throughput_exceeds_python():
     """The fast path must beat the Python parser on a bulk re-parse
-    (its reason to exist: fill_pr/enrich over archived submissions)."""
+    (its reason to exist: fill_pr/enrich over archived submissions).
+
+    Interleaved best-of-N: a single back-to-back wall-clock A/B on a
+    loaded 2-core host is a coin flip (the round-2 suite's one flake);
+    comparing the *floors* of interleaved samples is deterministic as
+    long as the native parser is genuinely faster, which it is by an
+    order of magnitude."""
     import time
 
     blob = tfx.pcap_bytes(FRAMES * 200)
-    t0 = time.perf_counter()
-    for _ in range(5):
+    t_fast = t_py = float("inf")
+    for _ in range(7):
+        t0 = time.perf_counter()
         native.extract_hashlines_fast(blob)
-    t_fast = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(5):
+        t_fast = min(t_fast, time.perf_counter() - t0)
+        t0 = time.perf_counter()
         extract_hashlines(blob)
-    t_py = time.perf_counter() - t0
+        t_py = min(t_py, time.perf_counter() - t0)
     assert t_fast < t_py
 
 

@@ -201,3 +201,37 @@ def test_pbkdf2_sha1_pmk():
         got = bo.words_to_bytes_be([np.asarray(w)[i] for w in pmk_words])
         want = hashlib.pbkdf2_hmac("sha1", pw, essid, 4096, 32)
         assert got == want, pw
+
+
+def test_sha1_hoisted_20_byte_specialization():
+    """sha1_compress_20 (the PBKDF2 loop's hoisted-prologue form) is
+    bit-identical to the generic compression over the fixed 20-byte
+    HMAC message shape, for random states and messages — the CPU-side
+    pin for the TPU kernel's hoist=True body."""
+    import numpy as np
+
+    from dwpa_tpu.ops.hmac import (
+        hmac_sha1_20,
+        hmac_sha1_20_hoisted,
+        hmac_sha1_20_prologue,
+    )
+    from dwpa_tpu.ops.sha1 import sha1_20_prologue, sha1_compress, sha1_compress_20
+
+    rng = np.random.default_rng(11)
+
+    def rnd5():
+        return tuple(
+            jnp.asarray(rng.integers(0, 2**32, (9,), dtype=np.uint64).astype(np.uint32))
+            for _ in range(5)
+        )
+
+    st, m5 = rnd5(), list(rnd5())
+    blk = m5 + [0x80000000] + [0] * 9 + [84 * 8]
+    for a, b in zip(sha1_compress(st, blk), sha1_compress_20(sha1_20_prologue(st), m5)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    ist, ost = rnd5(), rnd5()
+    ref = hmac_sha1_20(ist, ost, m5)
+    got = hmac_sha1_20_hoisted(hmac_sha1_20_prologue(ist, ost), m5)
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -442,3 +442,185 @@ def test_concurrent_get_work_never_double_issues():
         assert owned == expect, (w["hkey"], owned, expect)
         total += expect
     assert core.db.q1("SELECT COUNT(*) c FROM n2d")["c"] == total
+
+
+# -- browser multipart upload + capture caps + dated archive (round 3) -----
+
+
+def _multipart_body(files, fields=None, boundary="----WebKitFormBoundaryx7Qq"):
+    """A browser-shaped multipart/form-data body (CRLF line ends,
+    Content-Type on file parts), as Chrome/Firefox emit for the
+    ?submit form (ui.page_submit)."""
+    out = bytearray()
+    for name, value in (fields or {}).items():
+        out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="{name}"\r\n\r\n{value}\r\n').encode()
+    for name, (fname, blob) in files.items():
+        out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="{name}"; filename="{fname}"\r\n'
+                "Content-Type: application/octet-stream\r\n\r\n").encode()
+        out += blob + b"\r\n"
+    out += f"--{boundary}--\r\n".encode()
+    return bytes(out), f"multipart/form-data; boundary={boundary}"
+
+
+def _call_ct(app, body, ctype, qs=""):
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = status
+
+    environ = {
+        "REQUEST_METHOD": "POST",
+        "PATH_INFO": "/",
+        "QUERY_STRING": qs,
+        "CONTENT_TYPE": ctype,
+        "CONTENT_LENGTH": str(len(body)),
+        "wsgi.input": io.BytesIO(body),
+        "REMOTE_ADDR": "9.9.9.9",
+    }
+    return out, b"".join(app(environ, start_response))
+
+
+def test_browser_multipart_upload_ingests_and_cracks(core):
+    """The ?submit form posts multipart/form-data back to /?submit
+    (submit.php:18-31 handles $_FILES on the same URL); the capture
+    must ingest through the same pipeline as the raw path and the
+    resulting net must crack end-to-end."""
+    app = make_wsgi_app(core)
+    blob, expected = tfx.make_handshake_capture(PSK, ESSID)
+    body, ctype = _multipart_body({"file": ("station.pcap", blob)})
+    # the exact target a browser derives from the action-less form
+    out, resp = _call_ct(app, body, ctype, qs="submit")
+    assert out["status"].startswith("200")
+    assert json.loads(resp)["new"] == expected
+    # the ingested nets crack with the real PSK via the normal accept path
+    _released(core)
+    _add_dict(core, [b"not-the-one", PSK])
+    _, wbody = _call(app, "POST", qs="get_work=2.2.0",
+                     body=json.dumps({"dictcount": 1}).encode())
+    work = json.loads(wbody)
+    bssid = hl.parse(work["hashes"][0]).mac_ap.hex()
+    _, pbody = _call(app, "POST", qs="put_work", body=json.dumps({
+        "hkey": work["hkey"], "type": "bssid",
+        "cand": [{"k": bssid, "v": PSK.hex()}],
+    }).encode())
+    assert pbody == b"OK"
+    assert core.db.q1("SELECT COUNT(*) c FROM nets WHERE n_state=1")["c"] >= 1
+
+
+def test_multipart_binary_safe_and_missing_file(core):
+    """Binary capture bytes containing CRLF/dash runs survive the part
+    split; a multipart body without any file part is a 400."""
+    from dwpa_tpu.server.api import _parse_multipart
+
+    blob = b"\r\n--tricky\r\n" + bytes(range(256)) * 4
+    body, ctype = _multipart_body({"file": ("x.bin", blob)},
+                                  fields={"key": "a" * 32})
+    fields, files = _parse_multipart(body, ctype)
+    assert files["file"] == ("x.bin", blob)
+    assert fields["key"] == "a" * 32
+
+    app = make_wsgi_app(core)
+    body, ctype = _multipart_body({}, fields={"note": "no file here"})
+    out, resp = _call_ct(app, body, ctype)
+    assert out["status"].startswith("400")
+
+
+def test_capture_cap_is_tight_8mib(core):
+    """Captures get their own 8 MiB cap (the reference's PHP upload
+    posture is single-digit MiB): cap+1 is 413 before any read; a
+    same-size claim under the cap proceeds to parsing (400 garbage)."""
+    from dwpa_tpu.server.api import CAPTURE_BODY_CAP
+
+    app = make_wsgi_app(core)
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = status
+
+    def env(n):
+        return {
+            "REQUEST_METHOD": "POST", "PATH_INFO": "/", "QUERY_STRING": "",
+            "CONTENT_LENGTH": str(n), "wsgi.input": io.BytesIO(b"not-a-cap"),
+            "REMOTE_ADDR": "9.9.9.9",
+        }
+
+    b"".join(app(env(CAPTURE_BODY_CAP + 1), start_response))
+    assert out["status"].startswith("413")
+    assert core.db.q1("SELECT COUNT(*) c FROM submissions")["c"] == 0
+    b"".join(app(env(CAPTURE_BODY_CAP), start_response))
+    assert out["status"].startswith("400")  # read, parsed, rejected as garbage
+
+
+def test_dated_capture_archive_and_reorder(core, tmp_path):
+    """Uploads archive under capdir/Y/m/d (common.php:492-514); the
+    reorder-captures tool migrates flat legacy files by mtime."""
+    import os
+    import time as _t
+
+    from dwpa_tpu.server.tools import reorder_captures
+
+    blob, _ = tfx.make_handshake_capture(PSK, ESSID)
+    submit_capture(core, blob)
+    row = core.db.q1("SELECT localfile FROM submissions")
+    day = _t.strftime("%Y/%m/%d")
+    assert f"/{day}/" in row["localfile"].replace("\\", "/")
+    assert os.path.isfile(row["localfile"])
+
+    # legacy flat file: plant one + a matching DB row, then reorder
+    legacy_md5 = hashlib.md5(b"legacy-blob").hexdigest()
+    flat = os.path.join(core.capdir, legacy_md5)
+    with open(flat, "wb") as f:
+        f.write(b"legacy-blob")
+    old = _t.time() - 400 * 86400
+    os.utime(flat, (old, old))
+    core.db.x("INSERT INTO submissions(localfile, hash, ip) VALUES (?,?,?)",
+              (flat, hashlib.md5(b"legacy-blob").digest(), ""))
+    rep = reorder_captures(core)
+    assert rep == {"moved": 1, "db_updated": 1}
+    newpath = core.db.q1(
+        "SELECT localfile FROM submissions WHERE hash = ?",
+        (hashlib.md5(b"legacy-blob").digest(),))["localfile"]
+    expect_day = _t.strftime("%Y/%m/%d", _t.localtime(old))
+    assert f"/{expect_day}/" in newpath.replace("\\", "/")
+    assert os.path.isfile(newpath)
+    assert reorder_captures(core) == {"moved": 0, "db_updated": 0}  # idempotent
+
+
+def test_sched_lock_is_cross_process(tmp_path):
+    """The scheduler mutex must serialize across processes (the
+    reference's SHM lockfile, common.php:320-332): serve and jobs run
+    as separate processes in the documented deployment."""
+    import subprocess
+    import sys
+    import time as _t
+
+    from dwpa_tpu.server.core import _SchedLock
+
+    dbpath = str(tmp_path / "wpa.sqlite")
+    child = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import fcntl, os, sys, time\n"
+            f"fd = os.open({dbpath + '.getwork.lock'!r}, os.O_CREAT | os.O_RDWR)\n"
+            "fcntl.flock(fd, fcntl.LOCK_EX)\n"
+            "print('locked', flush=True)\n"
+            "time.sleep(1.0)\n"
+            "fcntl.flock(fd, fcntl.LOCK_UN)\n"
+        )],
+        stdout=subprocess.PIPE,
+    )
+    try:
+        assert child.stdout.readline().strip() == b"locked"
+        lock = _SchedLock(dbpath)
+        t0 = _t.perf_counter()
+        with lock:
+            waited = _t.perf_counter() - t0
+        # the parent must have blocked until the child released (~1 s)
+        assert waited > 0.4, waited
+        # reentrancy still holds
+        with lock:
+            with lock:
+                pass
+    finally:
+        child.wait()
