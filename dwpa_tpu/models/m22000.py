@@ -556,12 +556,21 @@ class M22000Engine:
         ).reshape(-1)
         for p in range(nproc):
             found[:, :, p * tgt + int(nvalids[p]):(p + 1) * tgt] = False
+        hit_cols = [int(b) for b in np.flatnonzero(found.any(axis=(0, 1)))]
+        if getattr(pws, "global_cols", False):
+            # Mask path: candidates are a pure function of the global
+            # keyspace index (_LazyWords), so every host materializes the
+            # hit words locally — identical bytes, no exchange needed.
+            return found, pmk_host, {b: pws[b] for b in hit_cols}
+        # Dict path: the candidate bytes exist only on the host that fed
+        # that shard (shard_candidates' process-local contract), while
+        # every host must decode identical founds so the engine's pruning
+        # (and the later compiled-step dispatch) stays in SPMD lockstep.
         # Fixed-shape candidate exchange: [used(1) col(4) len(1) psk(63)]
         # rows, MAX_FINDS_PER_BATCH per round.  Every host derives every
         # host's owned-hit count from the (replicated) find matrix, so
         # all agree on the round count with no extra collective — and no
         # hit is ever dropped, however dense the batch.
-        hit_cols = [int(b) for b in np.flatnonzero(found.any(axis=(0, 1)))]
         owned = {p: [b for b in hit_cols if b // tgt == p]
                  for p in range(nproc)}
         rounds = max(
@@ -652,21 +661,35 @@ class M22000Engine:
             return []
         return self._collect(self._dispatch(prep))
 
+    #: In-flight batches kept queued on the device ahead of the sync
+    #: point.  2 = a three-deep pipeline: while batch N is fetched and
+    #: decoded, N+1 is computing and N+2's H2D is in flight, so both the
+    #: hits-gate round trip AND the ~8 MB candidate upload hide behind a
+    #: full batch of PBKDF2 compute (measured: two-deep leaves ~10% of
+    #: steady-state on the tunnelled chip in un-overlapped H2D/RTT).
+    PIPELINE_DEPTH = 2
+
     def crack(self, candidates, on_batch=None) -> list:
         """Stream candidates in engine-sized batches until exhausted.
 
-        Two-deep software pipeline: while the device crunches batch N, the
-        host decodes/packs batch N+1 and enqueues its (async) H2D copy, so
-        PBKDF2 compute hides the candidate transfer instead of serializing
-        behind it — the double-buffering SURVEY.md §7.3.3 calls for.
+        Three-deep software pipeline: while the device crunches batch N,
+        the host packs and uploads batches N+1/N+2, and the hits-gate
+        sync always trails the dispatch frontier by ``PIPELINE_DEPTH``
+        batches — the double-buffering SURVEY.md §7.3.3 calls for, one
+        stage deeper to also hide the device->host gate latency.
 
         ``on_batch(consumed, founds)`` is invoked after each batch
-        completes (consumed = raw candidates in that batch, founds = its
-        Found list) — the checkpoint seam the client's intra-unit resume
-        hangs off (the hashcat ``--session`` analog, help_crack.py:773).
+        completes, in stream order (consumed = raw candidates in that
+        batch, founds = its Found list) — the checkpoint seam the
+        client's intra-unit resume hangs off (the hashcat ``--session``
+        analog, help_crack.py:773).  At-least-once: up to
+        ``PIPELINE_DEPTH`` dispatched-but-unreported batches replay
+        after a crash.
         """
+        import collections
+
         founds = []
-        in_flight = None   # (dispatched, raw_count)
+        pending = collections.deque()  # (dispatched, raw_count), oldest first
         batch = []
 
         def finish(dispatched, raw):
@@ -676,28 +699,26 @@ class M22000Engine:
                 on_batch(raw, new)
 
         def submit(b):
-            nonlocal in_flight
             prep = self._prepare(b)        # async H2D starts here
-            # Dispatch N+1 BEFORE syncing on batch N: the device queue
-            # always holds the next batch, so the hits-gate round trip
-            # and found-decode of N overlap N+1's compute instead of
-            # idling the chip (~17% of steady-state on the tunnelled
-            # chip).  A find in N is still honored for N+1 at decode
-            # time — _collect masks rows by the live-net set.
-            nxt = None
+            # A find in an in-flight batch is still honored for the
+            # batches behind it at decode time — _collect masks rows by
+            # the live-net set, so overshoot costs only the rare find
+            # batch's compute.
             if prep is not None and self.groups:
-                nxt = (self._dispatch(prep), len(b))  # launch N+1
-            if in_flight is not None:
-                finish(*in_flight)         # sync on batch N
-            if nxt is None and on_batch is not None:
-                # nothing dispatchable: still consumed — reported only
-                # AFTER batch N's finish so checkpoints stay in stream
-                # order (the client's resume skip-by-count depends on it)
-                on_batch(len(b), [])
-            in_flight = nxt
+                pending.append((self._dispatch(prep), len(b)))
+                if len(pending) > self.PIPELINE_DEPTH:
+                    finish(*pending.popleft())
+            else:
+                # nothing dispatchable: still consumed — drain the
+                # pipeline first so checkpoints stay in stream order
+                # (the client's resume skip-by-count depends on it)
+                while pending:
+                    finish(*pending.popleft())
+                if on_batch is not None:
+                    on_batch(len(b), [])
 
         for pw in candidates:
-            if not self.groups and in_flight is None:
+            if not self.groups and not pending:
                 break
             batch.append(pw)
             if len(batch) == self.batch_size:
@@ -705,8 +726,8 @@ class M22000Engine:
                 batch = []
         if batch:
             submit(batch)
-        if in_flight is not None:
-            finish(*in_flight)
+        while pending:
+            finish(*pending.popleft())
         return founds
 
     def crack_mask(self, mask: str, skip: int = 0, limit: int = None,
@@ -728,7 +749,14 @@ class M22000Engine:
         from ..parallel.mesh import DP_AXIS
 
         class _LazyWords:
-            """pws stand-in: index -> word bytes, computed on demand."""
+            """pws stand-in: index -> word bytes, computed on demand.
+
+            Indexed by GLOBAL batch column (a pure function of the
+            keyspace position) — on a multi-process mesh every host can
+            materialize any column, so the find decode skips the
+            candidate exchange (see _gather_find_data)."""
+
+            global_cols = True
 
             def __init__(self, start):
                 self.start = start
@@ -737,14 +765,19 @@ class M22000Engine:
                 return next(mask_words(mask, custom,
                                        skip=self.start + b, limit=1))
 
+        import collections
+
         total = mask_keyspace(mask, custom)
         end = total if limit is None else min(total, skip + limit)
         founds = []
-        in_flight = None
+        pending = collections.deque()  # (dispatched, raw_count)
         pos = skip
         while True:
-            nxt = None
-            if pos < end and self.groups:
+            # Keep PIPELINE_DEPTH+1 batches in flight (same pipelining
+            # rationale as crack(); the device-side generator makes the
+            # fill essentially free).
+            while (pos < end and self.groups
+                   and len(pending) <= self.PIPELINE_DEPTH):
                 n = min(self.batch_size, end - pos)
                 # generate a full mesh-multiple; _collect masks columns
                 # past nvalid (wrap-around words never count)
@@ -758,14 +791,14 @@ class M22000Engine:
                     sharding=NamedSharding(self.mesh, P(DP_AXIS, None)),
                 )
                 self.stage_times["prepare"] += time.perf_counter() - t0
-                nxt = (self._dispatch((_LazyWords(pos), n, pw_words)), n)
+                pending.append(
+                    (self._dispatch((_LazyWords(pos), n, pw_words)), n)
+                )
                 pos += n
-            if in_flight is not None:
-                dispatched, raw = in_flight
-                new = self._collect(dispatched)
-                founds.extend(new)
-                if on_batch is not None:
-                    on_batch(raw, new)
-            in_flight = nxt
-            if in_flight is None:
+            if not pending:
                 return founds
+            dispatched, raw = pending.popleft()
+            new = self._collect(dispatched)
+            founds.extend(new)
+            if on_batch is not None:
+                on_batch(raw, new)

@@ -82,7 +82,9 @@ class _SchedLock:
         if self._depth == 1:
             try:
                 import fcntl
-
+            except ImportError:
+                return self  # no fcntl (Windows): thread-only, like r2
+            try:
                 self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
                 fcntl.flock(self._fd, fcntl.LOCK_EX)
             except BaseException:

@@ -68,6 +68,20 @@ def main():
     pruned = len(eng.nets) == 0
     print(f"ENGINE {pid} finds={len(finds)} psk={got} pruned={pruned}",
           flush=True)
+
+    # Mask-path find decode: candidates are generated on device from the
+    # global keyspace index (_LazyWords), so there is no candidate
+    # exchange — each host must materialize the hit word from the GLOBAL
+    # column (a local-index lookup would fetch the wrong word whenever
+    # the hit lives on a non-zero process's shard).  "123456?d?d" with
+    # limit 8 puts PSK 12345607 at global column 7 — process 1's shard.
+    eng2 = m.M22000Engine(
+        [tfx.make_pmkid_line(b"12345607", b"MaskNet", seed="mh-mask")],
+        mesh=mesh, batch_size=mesh.size,
+    )
+    finds2 = eng2.crack_mask("123456?d?d", skip=0, limit=8)
+    got2 = finds2[0].psk.decode() if finds2 else "NONE"
+    print(f"MASK {pid} finds={len(finds2)} psk={got2}", flush=True)
     jax.distributed.shutdown()
 
 

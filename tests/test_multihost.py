@@ -44,3 +44,6 @@ def test_two_process_mesh_crack_step():
         # must work when the hit lives on a non-addressable shard)
         assert f"ENGINE {pid} finds=1 psk=multihost99 pruned=True" in out, \
             (pid, out)
+        # mask path: the hit word is materialized from the global
+        # keyspace column on both hosts (no candidate exchange)
+        assert f"MASK {pid} finds=1 psk=12345607" in out, (pid, out)
