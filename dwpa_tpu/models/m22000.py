@@ -356,6 +356,53 @@ class _PackedWords:
         return bo.words_to_bytes_be(self.words[b])[: int(self.lens[b])]
 
 
+class _Pipeline:
+    """Shared dispatch/sync pipeline for the engine's crack paths.
+
+    Holds up to ``engine.PIPELINE_DEPTH`` dispatched batches; ``push``
+    finishes the oldest once the depth is exceeded, so the hits-gate
+    sync always trails the dispatch frontier.  ``on_batch`` fires in
+    stream order — crack() and crack_mask() share these semantics by
+    construction instead of re-implementing them (they had already
+    drifted on effective depth once).
+    """
+
+    def __init__(self, engine, on_batch=None):
+        import collections
+
+        self.engine = engine
+        self.on_batch = on_batch
+        self.pending = collections.deque()  # (dispatched, raw), oldest first
+        self.founds = []
+
+    @property
+    def active(self) -> bool:
+        return bool(self.pending)
+
+    def push(self, dispatched, raw: int):
+        self.pending.append((dispatched, raw))
+        if len(self.pending) > self.engine.PIPELINE_DEPTH:
+            self.finish_one()
+
+    def skip(self, raw: int):
+        """A consumed-but-undispatchable batch: drain first so the
+        report keeps stream order (resume skip-by-count depends on it)."""
+        self.drain()
+        if self.on_batch is not None:
+            self.on_batch(raw, [])
+
+    def finish_one(self):
+        dispatched, raw = self.pending.popleft()
+        new = self.engine._collect(dispatched)
+        self.founds.extend(new)
+        if self.on_batch is not None:
+            self.on_batch(raw, new)
+
+    def drain(self):
+        while self.pending:
+            self.finish_one()
+
+
 class M22000Engine:
     """Crack a set of m22000 hashlines with batches of candidate PSKs.
 
@@ -551,17 +598,22 @@ class M22000Engine:
         nproc = jax.process_count()
         pid = jax.process_index()
         tgt = found.shape[2] // nproc  # equal local batches (see _prepare)
+        if getattr(pws, "global_cols", False):
+            # Mask path: nvalid counts GLOBAL columns (crack_mask's n)
+            # and candidates are a pure function of the global keyspace
+            # index (_LazyWords), so mask the tail globally and let every
+            # host materialize the hit words locally — identical bytes,
+            # no exchange needed.  (The per-process masking below would
+            # leave wrap/out-of-limit columns live on a partial batch.)
+            found[:, :, nvalid:] = False
+            hit_cols = [int(b) for b in np.flatnonzero(found.any(axis=(0, 1)))]
+            return found, pmk_host, {b: pws[b] for b in hit_cols}
         nvalids = np.asarray(
             multihost_utils.process_allgather(np.array([nvalid]))
         ).reshape(-1)
         for p in range(nproc):
             found[:, :, p * tgt + int(nvalids[p]):(p + 1) * tgt] = False
         hit_cols = [int(b) for b in np.flatnonzero(found.any(axis=(0, 1)))]
-        if getattr(pws, "global_cols", False):
-            # Mask path: candidates are a pure function of the global
-            # keyspace index (_LazyWords), so every host materializes the
-            # hit words locally — identical bytes, no exchange needed.
-            return found, pmk_host, {b: pws[b] for b in hit_cols}
         # Dict path: the candidate bytes exist only on the host that fed
         # that shard (shard_candidates' process-local contract), while
         # every host must decode identical founds so the engine's pruning
@@ -672,11 +724,12 @@ class M22000Engine:
     def crack(self, candidates, on_batch=None) -> list:
         """Stream candidates in engine-sized batches until exhausted.
 
-        Three-deep software pipeline: while the device crunches batch N,
-        the host packs and uploads batches N+1/N+2, and the hits-gate
-        sync always trails the dispatch frontier by ``PIPELINE_DEPTH``
-        batches — the double-buffering SURVEY.md §7.3.3 calls for, one
-        stage deeper to also hide the device->host gate latency.
+        Three-deep software pipeline (``_Pipeline``): while the device
+        crunches batch N, the host packs and uploads batches N+1/N+2,
+        and the hits-gate sync always trails the dispatch frontier by
+        ``PIPELINE_DEPTH`` batches — the double-buffering SURVEY.md
+        §7.3.3 calls for, one stage deeper to also hide the
+        device->host gate latency.
 
         ``on_batch(consumed, founds)`` is invoked after each batch
         completes, in stream order (consumed = raw candidates in that
@@ -686,17 +739,8 @@ class M22000Engine:
         ``PIPELINE_DEPTH`` dispatched-but-unreported batches replay
         after a crash.
         """
-        import collections
-
-        founds = []
-        pending = collections.deque()  # (dispatched, raw_count), oldest first
+        pipe = _Pipeline(self, on_batch)
         batch = []
-
-        def finish(dispatched, raw):
-            new = self._collect(dispatched)
-            founds.extend(new)
-            if on_batch is not None:
-                on_batch(raw, new)
 
         def submit(b):
             prep = self._prepare(b)        # async H2D starts here
@@ -705,20 +749,12 @@ class M22000Engine:
             # the live-net set, so overshoot costs only the rare find
             # batch's compute.
             if prep is not None and self.groups:
-                pending.append((self._dispatch(prep), len(b)))
-                if len(pending) > self.PIPELINE_DEPTH:
-                    finish(*pending.popleft())
+                pipe.push(self._dispatch(prep), len(b))
             else:
-                # nothing dispatchable: still consumed — drain the
-                # pipeline first so checkpoints stay in stream order
-                # (the client's resume skip-by-count depends on it)
-                while pending:
-                    finish(*pending.popleft())
-                if on_batch is not None:
-                    on_batch(len(b), [])
+                pipe.skip(len(b))
 
         for pw in candidates:
-            if not self.groups and not pending:
+            if not self.groups and not pipe.active:
                 break
             batch.append(pw)
             if len(batch) == self.batch_size:
@@ -726,9 +762,8 @@ class M22000Engine:
                 batch = []
         if batch:
             submit(batch)
-        while pending:
-            finish(*pending.popleft())
-        return founds
+        pipe.drain()
+        return pipe.founds
 
     def crack_mask(self, mask: str, skip: int = 0, limit: int = None,
                    custom: dict = None, on_batch=None) -> list:
@@ -765,40 +800,25 @@ class M22000Engine:
                 return next(mask_words(mask, custom,
                                        skip=self.start + b, limit=1))
 
-        import collections
-
         total = mask_keyspace(mask, custom)
         end = total if limit is None else min(total, skip + limit)
-        founds = []
-        pending = collections.deque()  # (dispatched, raw_count)
+        pipe = _Pipeline(self, on_batch)  # same depth semantics as crack()
         pos = skip
-        while True:
-            # Keep PIPELINE_DEPTH+1 batches in flight (same pipelining
-            # rationale as crack(); the device-side generator makes the
-            # fill essentially free).
-            while (pos < end and self.groups
-                   and len(pending) <= self.PIPELINE_DEPTH):
-                n = min(self.batch_size, end - pos)
-                # generate a full mesh-multiple; _collect masks columns
-                # past nvalid (wrap-around words never count)
-                gen = -(-n // self.mesh.size) * self.mesh.size
-                t0 = time.perf_counter()
-                # generated directly under the dp sharding: each device
-                # (across all hosts) materializes only its own candidate
-                # shard — no redistribution, no host-side bytes
-                pw_words = device_mask_words(
-                    mask, pos, gen, custom,
-                    sharding=NamedSharding(self.mesh, P(DP_AXIS, None)),
-                )
-                self.stage_times["prepare"] += time.perf_counter() - t0
-                pending.append(
-                    (self._dispatch((_LazyWords(pos), n, pw_words)), n)
-                )
-                pos += n
-            if not pending:
-                return founds
-            dispatched, raw = pending.popleft()
-            new = self._collect(dispatched)
-            founds.extend(new)
-            if on_batch is not None:
-                on_batch(raw, new)
+        while pos < end and self.groups:
+            n = min(self.batch_size, end - pos)
+            # generate a full mesh-multiple; _collect masks columns
+            # past nvalid (wrap-around words never count)
+            gen = -(-n // self.mesh.size) * self.mesh.size
+            t0 = time.perf_counter()
+            # generated directly under the dp sharding: each device
+            # (across all hosts) materializes only its own candidate
+            # shard — no redistribution, no host-side bytes
+            pw_words = device_mask_words(
+                mask, pos, gen, custom,
+                sharding=NamedSharding(self.mesh, P(DP_AXIS, None)),
+            )
+            self.stage_times["prepare"] += time.perf_counter() - t0
+            pipe.push(self._dispatch((_LazyWords(pos), n, pw_words)), n)
+            pos += n
+        pipe.drain()
+        return pipe.founds

@@ -82,6 +82,20 @@ def main():
     finds2 = eng2.crack_mask("123456?d?d", skip=0, limit=8)
     got2 = finds2[0].psk.decode() if finds2 else "NONE"
     print(f"MASK {pid} finds={len(finds2)} psk={got2}", flush=True)
+
+    # Partial final batch: limit=6 pads the generated batch to 8 mesh
+    # columns, so keyspace words 6-7 exist on device but lie OUTSIDE the
+    # requested window — word 5 must be found, word 7 must NOT (adjacent
+    # distributed work units would otherwise double-claim it).  Pins the
+    # global (not per-process) tail masking of the mask path's decode.
+    eng3 = m.M22000Engine(
+        [tfx.make_pmkid_line(b"12345605", b"MaskNet3", seed="mh-p1"),
+         tfx.make_pmkid_line(b"12345607", b"MaskNet4", seed="mh-p2")],
+        mesh=mesh, batch_size=mesh.size,
+    )
+    finds3 = eng3.crack_mask("123456?d?d", skip=0, limit=6)
+    got3 = ",".join(sorted(f.psk.decode() for f in finds3))
+    print(f"MASKPART {pid} finds={got3}", flush=True)
     jax.distributed.shutdown()
 
 

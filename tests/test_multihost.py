@@ -47,3 +47,6 @@ def test_two_process_mesh_crack_step():
         # mask path: the hit word is materialized from the global
         # keyspace column on both hosts (no candidate exchange)
         assert f"MASK {pid} finds=1 psk=12345607" in out, (pid, out)
+        # partial final batch: in-window word found, padding column
+        # beyond the limit never reported
+        assert f"MASKPART {pid} finds=12345605" in out, (pid, out)

@@ -62,3 +62,31 @@ def test_pallas_full_4096_matches_hashlib():
     for i in (0, 31, 63):
         ref = hashlib.pbkdf2_hmac("sha1", pws[i], essid, 4096, 32)
         assert bo.words_to_bytes_be(out[:, i]) == ref
+
+
+def test_tpu_throughput_floor():
+    """Regression floor for the hot kernel on real hardware: the r3
+    pipelined mask path sustains ~240-265k PMK/s on a v5e chip; a drop
+    below 150k means a kernel/pipeline regression, not tunnel noise
+    (worst observed variance is ~±10%).  TPU-gated — CPU interpret mode
+    measures nothing relevant."""
+    if not ON_TPU:
+        import pytest
+
+        pytest.skip("throughput floor only meaningful on the TPU")
+    import time
+
+    from dwpa_tpu import testing as T
+    from dwpa_tpu.models.m22000 import M22000Engine
+
+    batch = 65536
+    engine = M22000Engine(
+        [T.make_pmkid_line(b"not-in-keyspace", b"floor-essid", seed="floor")],
+        batch_size=batch,
+    )
+    n = 4 * batch
+    engine.crack_mask("?d?d?d?d?d?d?d?d", skip=n, limit=batch)  # warm/compile
+    t0 = time.perf_counter()
+    engine.crack_mask("?d?d?d?d?d?d?d?d", skip=0, limit=n)
+    rate = n / (time.perf_counter() - t0)
+    assert rate > 150_000, f"kernel throughput regressed: {rate:.0f} PMK/s"

@@ -624,3 +624,48 @@ def test_sched_lock_is_cross_process(tmp_path):
                 pass
     finally:
         child.wait()
+
+
+def test_router_fuzz_never_crashes(core):
+    """Seeded fuzz over the whole WSGI surface: random methods, paths,
+    query keys, bodies, content types, cookies — every response must be
+    a handled status (2xx/3xx/4xx), never a 5xx or an unhandled
+    exception.  The front controller's only allowed failure modes are
+    400 (bad input) and 413 (oversize), like the reference's guarded
+    index.php routing."""
+    import random
+
+    app = make_wsgi_app(core)
+    rng = random.Random(7)
+    keys = ["get_work", "put_work", "prdict", "api", "stats", "nets",
+            "search", "my_nets", "dicts", "home", "submit", "get_key",
+            "key", "page", "remkey"]
+    bodies = [b"", b"\x00" * 64, b"{bad json", b"a=b&c=d", b"WPA*junk",
+              b"--x\r\nContent-Disposition: form-data\r\n\r\n",
+              bytes(range(256)), b"mail=x&key=" + b"f" * 32]
+    ctypes = ["", "application/x-www-form-urlencoded", "application/json",
+              "multipart/form-data; boundary=x", "multipart/form-data",
+              "text/plain"]
+    paths = ["/", "", "/dict/../etc/passwd", "/dict/x.gz", "/hc/../../x",
+             "/zzz"]
+    vals = ["", "1", "ff" * 16, "%00", "x" * 200, "2.2.0"]
+    for _ in range(1500):
+        qs = "&".join(f"{rng.choice(keys)}={rng.choice(vals)}"
+                      for _ in range(rng.randrange(0, 4)))
+        body = rng.choice(bodies)
+        environ = {
+            "REQUEST_METHOD": rng.choice(["GET", "POST", "PUT", "HEAD"]),
+            "PATH_INFO": rng.choice(paths),
+            "QUERY_STRING": qs,
+            "CONTENT_TYPE": rng.choice(ctypes),
+            "CONTENT_LENGTH": rng.choice([str(len(body)), "", "-5", "zz",
+                                          "999"]),
+            "wsgi.input": io.BytesIO(body),
+            "REMOTE_ADDR": "1.2.3.4",
+            "HTTP_COOKIE": rng.choice(["", "key=zz", "key=" + "a" * 32,
+                                       ";;;="]),
+            "HTTP_ACCEPT": rng.choice(["", "text/html"]),
+        }
+        status = []
+        list(app(environ, lambda s, h: status.append(s)))
+        assert status and not status[0].startswith("5"), (environ, status)
