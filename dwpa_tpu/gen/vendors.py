@@ -350,7 +350,9 @@ def alice_agpf_keys(ssid_digits: str, bssid: bytes, configs=None):
     s = int(ssid_digits)
     for entry in configs.get(ssid_digits[:2], []):
         q, k = entry["q"], entry["k"]
-        if k <= 0 or (s - q) % k:
+        # s < q would format a negative quotient into the serial — no
+        # such device exists; skip rather than emit garbage candidates.
+        if k <= 0 or s < q or (s - q) % k:
             continue
         serial = "%sX%07d" % (entry["sn"], (s - q) // k)
         base = int.from_bytes(bssid, "big")
@@ -367,12 +369,16 @@ MAC_FULL_SSID_RE = re.compile(rb"^(?:CVTV|Megared|INTERCABLE)", re.I)
 
 
 def mac_full_keys(bssid: bytes):
+    seen = set()
     for umac in _mac_neighbours(bssid):
         mac = umac.lower()
-        yield mac.encode()
-        yield umac.encode()
-        yield mac[2:].encode()
-        yield umac[2:].encode()
+        for cand in (mac.encode(), umac.encode(),
+                     mac[2:].encode(), umac[2:].encode()):
+            # all-decimal MACs make the case variants identical; each
+            # duplicate would cost a wasted PBKDF2 verify downstream
+            if cand not in seen:
+                seen.add(cand)
+                yield cand
 
 
 # ---------------------------------------------------------------------------

@@ -513,7 +513,7 @@ class M22000Engine:
         if fast is not None:
             packed, lens, nvalid = fast
             if nvalid == 0:
-                return None
+                return self._padding_prep(t0)
             # Size the device batch from the post-filter count, exactly
             # like the fallback: an oversize batch full of invalid words
             # must not inflate the shape (extra zero-row PBKDF2s and a
@@ -529,7 +529,7 @@ class M22000Engine:
         pws = [oracle.hc_unhex(p) for p in plist]
         pws = [p for p in pws if MIN_PSK_LEN <= len(p) <= MAX_PSK_LEN]
         if not pws:
-            return None
+            return self._padding_prep(t0)
         nvalid = len(pws)
         target = max(self.batch_size, -(-nvalid // self.mesh.size) * self.mesh.size)
         if nvalid < target:
@@ -537,6 +537,28 @@ class M22000Engine:
         pw_words = shard_candidates(self.mesh, bo.pack_passwords_be(pws))
         self.stage_times["prepare"] += time.perf_counter() - t0
         return pws, nvalid, pw_words
+
+    def _padding_prep(self, t0):
+        """All-padding batch for a shard that contributed no valid words.
+
+        On a multi-process mesh every host must enter the shard_map
+        collective in lockstep: if this host returned None (skip) while
+        its peers dispatched, their devices would wait forever.  A
+        batch_size block of zero rows keeps the step shapes identical
+        everywhere; nvalid=0 masks every column at decode, so the only
+        cost is one batch of wasted PBKDF2 on this host's shard — paid
+        on the rare all-invalid shard, never on the common path.
+        Single-process engines keep the cheap skip instead.
+        """
+        from ..parallel import shard_candidates
+
+        if jax.process_count() <= 1:
+            return None
+        pw_words = shard_candidates(
+            self.mesh, np.zeros((self.batch_size, 16), np.uint32)
+        )
+        self.stage_times["prepare"] += time.perf_counter() - t0
+        return [], 0, pw_words
 
     def _dispatch(self, prep):
         """Launch the crack step for every live ESSID group (no host sync).
@@ -738,6 +760,14 @@ class M22000Engine:
         analog, help_crack.py:773).  At-least-once: up to
         ``PIPELINE_DEPTH`` dispatched-but-unreported batches replay
         after a crash.
+
+        Multi-process contract: every host must feed the SAME NUMBER of
+        same-sized batches (each host passing its local shard of a
+        globally-agreed stream, as the multihost client does) — batch
+        COUNT divergence would desync the shard_map collectives.  A
+        host whose shard of some batch holds no valid words is safe:
+        _prepare dispatches an all-padding block instead of skipping,
+        keeping the slice in lockstep.
         """
         pipe = _Pipeline(self, on_batch)
         batch = []

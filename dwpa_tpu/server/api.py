@@ -379,10 +379,19 @@ def submit_capture(core: ServerCore, blob: bytes, ip: str = "",
     hashline text so converted archives ingest directly.
     """
     if blob[:2] == b"\x1f\x8b":
+        # Bounded decompression: an 8 MiB gzip bomb inflates ~1000x, so
+        # an unbounded gzip.decompress would defeat CAPTURE_BODY_CAP's
+        # whole point (the hostile-upload memory bound).  The cap applies
+        # to the decompressed capture too — no real pcap needs more.
+        import io
+
         try:
-            blob = gzip.decompress(blob)
-        except OSError:
+            with gzip.GzipFile(fileobj=io.BytesIO(blob)) as gf:
+                blob = gf.read(CAPTURE_BODY_CAP + 1)
+        except (OSError, EOFError):
             raise ValueError("bad gzip")
+        if len(blob) > CAPTURE_BODY_CAP:
+            raise BodyTooLarge(len(blob))
     s_id = core.add_submission(blob, ip=ip)
     if blob[:4].lstrip()[:3] == b"WPA":
         lines = blob.decode("utf-8", "replace").splitlines()

@@ -159,8 +159,9 @@ class ThreeWifiClient:
                 row = d[0] if isinstance(d, list) else d
                 mac = bytes.fromhex(row["bssid"].replace(":", "").lower())
                 key = row["key"]
-            except (KeyError, TypeError, ValueError, IndexError):
-                continue  # empty candidate list / malformed row: skip it
+            except (KeyError, TypeError, ValueError, IndexError,
+                    AttributeError):
+                continue  # empty list / malformed row (e.g. numeric bssid)
             if len(mac) == 6 and key:
                 out[mac] = key.encode() if isinstance(key, str) else key
         return out
@@ -238,4 +239,20 @@ def _nslookup_mx(domain: str) -> bool:
         [exe, "-type=MX", domain + "."],
         capture_output=True, text=True, timeout=10,
     )
-    return "mail exchanger" in out.stdout.lower()
+    return _parse_mx_output(out.stdout + out.stderr)
+
+
+def _parse_mx_output(text: str) -> bool:
+    """Decide MX presence from resolver output.
+
+    Only an affirmative "domain does not resolve" rejects the address;
+    anything else (busybox nslookup without -type support, odd output
+    formats) fails open — a present-but-incompatible resolver must not
+    silently lock every user out of key issuance.
+    """
+    text = text.lower()
+    if "mail exchanger" in text:
+        return True
+    negatives = ("nxdomain", "can't find", "no servers could be reached",
+                 "server can't", "non-existent domain")
+    return not any(n in text for n in negatives)

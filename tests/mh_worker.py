@@ -96,6 +96,23 @@ def main():
     finds3 = eng3.crack_mask("123456?d?d", skip=0, limit=6)
     got3 = ",".join(sorted(f.psk.decode() for f in finds3))
     print(f"MASKPART {pid} finds={got3}", flush=True)
+
+    # All-invalid local shard on process 0: _prepare must dispatch an
+    # all-padding block (a skip would desync the shard_map collectives
+    # and hang process 1 forever) and process 1's find still decodes on
+    # both hosts through the candidate exchange.
+    eng4 = m.M22000Engine(
+        [tfx.make_pmkid_line(b"padlock-psk7", b"PadNet", seed="mh-pad")],
+        mesh=mesh, batch_size=mesh.size,
+    )
+    if pid == 0:
+        local4 = [b"x" * 70] * (batch2 // 2)  # every word too long
+    else:
+        local4 = [b"pw-%05d" % i for i in range(batch2 // 2)]
+        local4[1] = b"padlock-psk7"
+    finds4 = eng4.crack_batch(local4)
+    got4 = finds4[0].psk.decode() if finds4 else "NONE"
+    print(f"PAD {pid} finds={len(finds4)} psk={got4}", flush=True)
     jax.distributed.shutdown()
 
 

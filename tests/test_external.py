@@ -306,3 +306,31 @@ def test_jobs_cli_wigle_api_flag(tmp_path, stub, capsys):
     row = core.db.q1("SELECT lat, lon, city FROM bssids")
     assert (row["lat"], row["lon"], row["city"]) == (1.5, 2.5, "Sofia")
     assert stub.requests[0]["headers"]["Authorization"] == "Basic k3y"
+
+
+def test_3wifi_numeric_bssid_row_skipped(stub):
+    """A malformed row with a non-string bssid is skipped, not a crash
+    of the whole lookup batch."""
+    stub.routes["/apiquery"] = ({
+        "result": True,
+        "data": [
+            [{"bssid": 112233445566, "key": "p"}],
+            [{"bssid": "AA:BB:CC:DD:EE:FF", "key": "good"}],
+        ],
+    }, 200)
+    cli = ThreeWifiClient("k", url=stub.url + "/apiquery")
+    assert cli([b"\xaa\xbb\xcc\xdd\xee\xff"]) == \
+        {b"\xaa\xbb\xcc\xdd\xee\xff": b"good"}
+
+
+def test_mx_output_parsing_fails_open():
+    """Resolver-output decision: affirmative answers and affirmative
+    NXDOMAINs decide; unrecognized tooling output fails open."""
+    from dwpa_tpu.server.external import _parse_mx_output
+
+    assert _parse_mx_output("example.com mail exchanger = 10 mx.example.com.")
+    assert not _parse_mx_output("** server can't find no-mx.example.: NXDOMAIN")
+    assert not _parse_mx_output(";; connection timed out; no servers could be reached")
+    # busybox nslookup without -type support: unrecognized -> fail open
+    assert _parse_mx_output("nslookup: invalid option -- t\nUsage: nslookup HOST")
+    assert _parse_mx_output("")

@@ -50,3 +50,6 @@ def test_two_process_mesh_crack_step():
         # partial final batch: in-window word found, padding column
         # beyond the limit never reported
         assert f"MASKPART {pid} finds=12345605" in out, (pid, out)
+        # an all-invalid shard on one host must not desync the slice:
+        # the other host's find still lands on both
+        assert f"PAD {pid} finds=1 psk=padlock-psk7" in out, (pid, out)

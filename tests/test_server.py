@@ -669,3 +669,27 @@ def test_router_fuzz_never_crashes(core):
         status = []
         list(app(environ, lambda s, h: status.append(s)))
         assert status and not status[0].startswith("5"), (environ, status)
+
+
+def test_gzip_bomb_rejected_413(core):
+    """A small gzip body that inflates past the capture cap is rejected
+    before the decompressed blob can exhaust memory (the cap applies to
+    the decompressed size, not just the wire size)."""
+    from dwpa_tpu.server.api import CAPTURE_BODY_CAP
+
+    bomb = gzip.compress(b"\x00" * (CAPTURE_BODY_CAP + 1024), 9)
+    assert len(bomb) < 1024 * 1024  # tiny on the wire
+    app = make_wsgi_app(core)
+    out = {}
+    environ = {
+        "REQUEST_METHOD": "POST", "PATH_INFO": "/", "QUERY_STRING": "",
+        "CONTENT_LENGTH": str(len(bomb)), "wsgi.input": io.BytesIO(bomb),
+        "REMOTE_ADDR": "9.9.9.9",
+    }
+    b"".join(app(environ, lambda s, h: out.update(status=s)))
+    assert out["status"].startswith("413")
+    assert core.db.q1("SELECT COUNT(*) c FROM submissions")["c"] == 0
+    # a normal gzipped capture still ingests
+    blob, expected = tfx.make_handshake_capture(PSK, ESSID)
+    report = submit_capture(core, gzip.compress(blob))
+    assert report["new"] == expected
