@@ -163,7 +163,12 @@ class Database:
     def __init__(self, path: str = ":memory:"):
         self.path = path
         self._lock = threading.RLock()
-        self.conn = sqlite3.connect(path, check_same_thread=False)
+        # 30 s busy wait (default is 5 s): an ops writer holding a
+        # transaction for a few seconds — migration tooling, a manual
+        # sqlite session, the jobs process mid-regen — must make API
+        # writes wait, not 500 them (the reference's MySQL posture).
+        self.conn = sqlite3.connect(path, check_same_thread=False,
+                                    timeout=30.0)
         self.conn.row_factory = sqlite3.Row
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA foreign_keys=ON")
