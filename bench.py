@@ -20,6 +20,7 @@ feeds distinct inputs so no layer can serve a cached result.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -34,6 +35,49 @@ RTX4090_PMKS = 2.5e6           # hashcat-CUDA m22000 on one RTX 4090
 PER_CHIP_TARGET = 2 * RTX4090_PMKS / 8   # north-star share per v5e chip
 
 ON_TPU = jax.devices()[0].platform == "tpu"
+
+
+def tpu_selftest() -> dict:
+    """Preflight: pin the production Pallas kernel against hashlib on the
+    real chip, every round.
+
+    The suite's conftest forces the CPU platform, so its full-4096
+    bit-exactness test only runs when someone sets DWPA_TEST_TPU=1 —
+    which recorded rounds never did.  This preflight closes that gap:
+    the exact kernel configuration the headline number is measured on
+    (hoisted prologue, default tile) is verified oracle-exact here, in
+    the same driver-recorded run, or bench fails loudly (rc != 0).
+    """
+    if not ON_TPU:
+        return {"label": "tpu_selftest", "status": "skipped_no_tpu"}
+    import hashlib
+
+    import jax.numpy as jnp
+
+    from dwpa_tpu.models.m22000 import essid_salt_blocks
+    from dwpa_tpu.ops.pbkdf2_pallas import pbkdf2_sha1_pmk_pallas
+    from dwpa_tpu.utils import bytesops as bo
+
+    essid = b"bench-selftest"
+    s1, s2 = essid_salt_blocks(essid)
+    # Lengths straddling both trimmed-width buckets and the 20-byte
+    # SHA-1 block boundary, like the TPU-gated unit test.
+    pws = [b"pw%06d" % i for i in range(32)]
+    pws += [b"longpassphrase-%016d" % i for i in range(32)]
+    out = np.asarray(
+        pbkdf2_sha1_pmk_pallas(
+            jnp.asarray(bo.pack_passwords_be(pws)), jnp.asarray(s1), jnp.asarray(s2)
+        )
+    )
+    for i in range(0, len(pws), 7):
+        ref = hashlib.pbkdf2_hmac("sha1", pws[i], essid, 4096, 32)
+        got = bo.words_to_bytes_be(out[:, i])
+        if got != ref:
+            raise SystemExit(
+                f"TPU SELFTEST FAILED: Pallas PBKDF2 not bit-exact for {pws[i]!r}"
+            )
+    return {"label": "tpu_selftest", "status": "pass",
+            "check": "pallas_pbkdf2_4096_vs_hashlib", "words": len(pws)}
 
 
 def bench_mask_pbkdf2(batch: int, batches: int = 8) -> dict:
@@ -175,9 +219,11 @@ def bench_host_feed(words: int = 200_000) -> dict:
 
     # Warm the worker pool first: spawning 2 interpreters costs ~10 s
     # once per process, amortized over a whole work unit in production.
-    sum(1 for _ in apply_rules(rules, base[:64], workers=2))
+    # force_pool bypasses the few-cores guard — the point here is to
+    # track the true pooled rate even on hosts where the guard trips.
+    sum(1 for _ in apply_rules(rules, base[:64], workers=2, force_pool=True))
     t0 = time.perf_counter()
-    n = sum(1 for _ in apply_rules(rules, base, workers=2))
+    n = sum(1 for _ in apply_rules(rules, base, workers=2, force_pool=True))
     out["rules_pooled2_cand_per_s"] = n / (time.perf_counter() - t0)
 
     cands = [b"packword%07d" % i for i in range(words)]
@@ -225,9 +271,18 @@ def _round(cfg: dict) -> dict:
 
 
 def main():
+    # Persistent compilation cache: the ~20-40 s PBKDF2 first-compile is
+    # paid once per machine, not once per bench run (mirrors the client's
+    # cold-start wiring, client/main.py).
+    from dwpa_tpu.utils.compcache import enable_compilation_cache
+
+    enable_compilation_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
+    )
     batch = 131072 if ON_TPU else 2048
     words = 1000
 
+    selftest = tpu_selftest()
     mask = bench_mask_pbkdf2(batch)
     psk = b"benchpass1"
     pmkid = bench_engine_dict(
@@ -252,6 +307,7 @@ def main():
                 "vs_baseline": round(value / PER_CHIP_TARGET, 4),
                 "platform": jax.devices()[0].device_kind,
                 "configs": {
+                    "tpu_selftest": _round(selftest),
                     "mask_pbkdf2": _round(mask),
                     "pmkid_dict": _round(pmkid),
                     "eapol_dict": _round(eapol),

@@ -88,6 +88,12 @@ class TpuCrackClient:
         os.makedirs(config.workdir, exist_ok=True)
         self.dictdir = os.path.join(config.workdir, "dicts")
         os.makedirs(self.dictdir, exist_ok=True)
+        # Cold-start: persist XLA compilations under the workdir so a
+        # restarted client skips the ~20-40 s PBKDF2 compile (SURVEY §5.4
+        # resume latency; tracked by bench.py unit_overhead).
+        from ..utils.compcache import enable_compilation_cache
+
+        enable_compilation_cache(os.path.join(config.workdir, "xla_cache"))
         self.resume_path = os.path.join(config.workdir, "resume.json")
         self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
         self.dictcount = max(1, min(15, config.dictcount))
@@ -141,7 +147,32 @@ class TpuCrackClient:
         founds = eng.crack(words)
         ok = len(founds) == 2 and all(f.psk == CHALLENGE_PSK for f in founds)
         self.log(f"challenge: {'passed' if ok else 'FAILED'}")
+        if ok:
+            self.prewarm()
         return ok
+
+    def prewarm(self):
+        """Compile (or cache-load) the work-sized crack steps behind the
+        challenge gate, so the first work unit never stalls on XLA.
+
+        Covers the PBKDF2 shapes real units hit: the configured batch
+        size at every trimmed candidate width (W=4 for words <= 16
+        chars — nearly every dict — W=8 up to 32, W=16 for the 33-63
+        passphrase tail).  With the persistent cache (see __init__) the
+        compile happens once per installation; afterwards this is
+        ~0.2 s of device work.
+        """
+        t0 = time.time()
+        eng = M22000Engine(
+            [synth.make_pmkid_line(CHALLENGE_PSK, b"dlink", seed="challenge-p")],
+            nc=self.cfg.nc, batch_size=self.cfg.batch_size,
+        )
+        n = eng.batch_size
+        eng.crack_batch([b"warm-%08d" % i for i in range(n)])
+        eng.crack_batch([b"warm-long-padding-%08d" % i for i in range(n)])
+        eng.crack_batch([b"warm-full-width-passphrase-padding-%08d" % i
+                         for i in range(n)])
+        self.log(f"prewarm: work-size steps ready in {time.time() - t0:.1f}s")
 
     # -- work-unit plumbing ------------------------------------------------
 

@@ -202,9 +202,18 @@ def _use_pallas() -> bool:
 
 def _pmk_impl(pw_words, salt1, salt2, use_pallas=None):
     """PBKDF2 batch: Pallas register-resident kernel on TPU (~4.8x the
-    pure-XLA fori_loop formulation on v5e), XLA path elsewhere."""
+    pure-XLA fori_loop formulation on v5e), XLA path elsewhere.
+
+    ``pw_words`` may arrive column-trimmed ([B, W<16]): the host ships
+    only the uint32 columns real candidates occupy (H2D through the
+    axon tunnel costs ~0.24 s/MB, so a 12-byte dict word must not pay
+    for a 64-byte row) and the zero tail of the HMAC key block is
+    reconstituted here, on device, where padding is a free fusion.
+    """
     if use_pallas is None:
         use_pallas = _use_pallas()
+    if pw_words.shape[1] < 16:
+        pw_words = jnp.pad(pw_words, ((0, 0), (0, 16 - pw_words.shape[1])))
     if use_pallas:
         return pbkdf2_sha1_pmk_pallas(pw_words, salt1, salt2)
     pw = [pw_words[:, i] for i in range(16)]
@@ -339,6 +348,26 @@ class Found:
     nc: int            # signed NC delta (0 = exact)
     endian: str        # "LE" | "BE" | "" (exact / PMKID)
     pmk: bytes
+
+
+def _trim_cols(max_len: int) -> int:
+    """uint32 columns to ship for a batch whose longest word is
+    ``max_len`` bytes, bucketed to {4, 8, 16} so jit sees at most three
+    width signatures.  The device pads back to the full 16-word HMAC
+    key block (see _pmk_impl); for typical dicts (words <= 16 chars)
+    this cuts candidate H2D traffic 4x — the difference between the
+    tunnel hiding behind compute and throttling the whole dict path.
+
+    Multi-process meshes always ship full rows: every host must enter
+    the shard_map with identical shapes, and hosts can't agree on a
+    width without a collective that would cost more than it saves."""
+    if jax.process_count() > 1:
+        return 16
+    need = -(-max_len // 4)
+    for w in (4, 8):
+        if need <= w:
+            return w
+    return 16
 
 
 class _PackedWords:
@@ -520,7 +549,10 @@ class M22000Engine:
             # fresh jit entry).
             target = max(self.batch_size,
                          -(-nvalid // self.mesh.size) * self.mesh.size)
-            pw_words = shard_candidates(self.mesh, packed[:target])
+            w = _trim_cols(int(lens.max()) if nvalid else MIN_PSK_LEN)
+            pw_words = shard_candidates(
+                self.mesh, np.ascontiguousarray(packed[:target, :w])
+            )
             self.stage_times["prepare"] += time.perf_counter() - t0
             return _PackedWords(packed, lens), nvalid, pw_words
 
@@ -532,9 +564,12 @@ class M22000Engine:
             return self._padding_prep(t0)
         nvalid = len(pws)
         target = max(self.batch_size, -(-nvalid // self.mesh.size) * self.mesh.size)
+        w = _trim_cols(max(len(p) for p in pws))
         if nvalid < target:
             pws = pws + [b"\x00" * MIN_PSK_LEN] * (target - nvalid)
-        pw_words = shard_candidates(self.mesh, bo.pack_passwords_be(pws))
+        pw_words = shard_candidates(
+            self.mesh, np.ascontiguousarray(bo.pack_passwords_be(pws)[:, :w])
+        )
         self.stage_times["prepare"] += time.perf_counter() - t0
         return pws, nvalid, pw_words
 
@@ -555,7 +590,8 @@ class M22000Engine:
         if jax.process_count() <= 1:
             return None
         pw_words = shard_candidates(
-            self.mesh, np.zeros((self.batch_size, 16), np.uint32)
+            self.mesh, np.zeros((self.batch_size, _trim_cols(MIN_PSK_LEN)),
+                                np.uint32)
         )
         self.stage_times["prepare"] += time.perf_counter() - t0
         return [], 0, pw_words
@@ -689,9 +725,13 @@ class M22000Engine:
                     found_dev, pmk_dev, pws, nvalid
                 )
             else:
-                found = np.array(found_dev)  # [N, V_max, B] (host copy)
+                # One device_get for both arrays: through the tunnel each
+                # D2H fetch costs ~0.13 s fixed, and the find path is part
+                # of every small work unit's constant overhead (the
+                # challenge gate, 1k-word PR-dict units).
+                found, pmk_host = jax.device_get((found_dev, pmk_dev))
+                found = np.array(found)  # writable host copy
                 found[:, :, nvalid:] = False
-                pmk_host = np.asarray(pmk_dev)
                 psk_by_col = None
             for ni, net in enumerate(group):
                 if id(net.line) not in live:
@@ -736,22 +776,26 @@ class M22000Engine:
         return self._collect(self._dispatch(prep))
 
     #: In-flight batches kept queued on the device ahead of the sync
-    #: point.  2 = a three-deep pipeline: while batch N is fetched and
-    #: decoded, N+1 is computing and N+2's H2D is in flight, so both the
-    #: hits-gate round trip AND the ~8 MB candidate upload hide behind a
-    #: full batch of PBKDF2 compute (measured: two-deep leaves ~10% of
-    #: steady-state on the tunnelled chip in un-overlapped H2D/RTT).
-    PIPELINE_DEPTH = 2
+    #: point.  3 = a four-deep pipeline: while batch N is fetched and
+    #: decoded, N+1/N+2 are computing and N+3's H2D is in flight, so
+    #: both the hits-gate round trip AND the (column-trimmed, ~2 MB)
+    #: candidate upload hide behind PBKDF2 compute.  Measured on the
+    #: tunnelled v5e at batch 128k: depth 2 -> 244k PMK/s, depth 3 ->
+    #: 250k (96% of the mask path's 260k), depth 4 -> flat; the extra
+    #: slot costs only one more batch of at-least-once replay after a
+    #: crash (see crack()).
+    PIPELINE_DEPTH = 3
 
     def crack(self, candidates, on_batch=None) -> list:
         """Stream candidates in engine-sized batches until exhausted.
 
-        Three-deep software pipeline (``_Pipeline``): while the device
-        crunches batch N, the host packs and uploads batches N+1/N+2,
-        and the hits-gate sync always trails the dispatch frontier by
-        ``PIPELINE_DEPTH`` batches — the double-buffering SURVEY.md
-        §7.3.3 calls for, one stage deeper to also hide the
-        device->host gate latency.
+        Software pipeline (``_Pipeline``), ``PIPELINE_DEPTH + 1`` deep:
+        while the device crunches batch N, the host packs and uploads
+        the next ``PIPELINE_DEPTH`` batches, and the hits-gate sync
+        always trails the dispatch frontier by ``PIPELINE_DEPTH``
+        batches — the double-buffering SURVEY.md §7.3.3 calls for,
+        deeper to also hide the device->host gate latency (see the
+        PIPELINE_DEPTH comment for the measured depth choice).
 
         ``on_batch(consumed, founds)`` is invoked after each batch
         completes, in stream order (consumed = raw candidates in that

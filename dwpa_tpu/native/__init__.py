@@ -33,7 +33,7 @@ def build(force: bool = False) -> str:
 def _configure_capture(lib):
     lib.dwpa_extract.restype = ctypes.c_int
     lib.dwpa_extract.argtypes = [
-        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_double,
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
     ]
     lib.dwpa_free.argtypes = [ctypes.c_char_p]
@@ -44,12 +44,14 @@ def load(auto_build: bool = True):
     return _load_lib(_SRC, _SO, _configure_capture, auto_build)
 
 
-def extract_hashlines_fast(blob: bytes, nc_hint: bool = True):
+def extract_hashlines_fast(blob: bytes, nc_hint: bool = True,
+                           eapol_timeout: float = 30.0):
     """Native twin of server.capture.extract_hashlines.
 
     Returns ([hashline str, ...], [probe ssid bytes, ...]); raises
     RuntimeError when the library is unavailable (callers select the
-    fast path explicitly and fall back themselves).
+    fast path explicitly and fall back themselves).  ``eapol_timeout``
+    mirrors hcxpcapngtool's --eapoltimeout pairing gate (seconds).
     """
     lib = load()
     if lib is None:
@@ -57,6 +59,7 @@ def extract_hashlines_fast(blob: bytes, nc_hint: bool = True):
     out = ctypes.c_char_p()
     out_len = ctypes.c_size_t()
     rc = lib.dwpa_extract(blob, len(blob), int(nc_hint),
+                          ctypes.c_double(eapol_timeout),
                           ctypes.byref(out), ctypes.byref(out_len))
     if rc != 0:
         raise RuntimeError(f"dwpa_extract failed: rc={rc}")

@@ -14,7 +14,7 @@
 //
 // C ABI:
 //   int  dwpa_extract(const uint8_t* blob, size_t len, int nc_hint,
-//                     char** out, size_t* out_len);
+//                     double eapol_timeout_s, char** out, size_t* out_len);
 //       out: malloc'd text, one record per line:
 //            "H <m22000 hashline>"  or  "P <hex probe ssid>"
 //       returns 0 on success (caller frees with dwpa_free), -1 on error.
@@ -66,11 +66,15 @@ struct EapolMsg {
     Bytes frame;  // full EAPOL, MIC zeroed, truncated to declared length
     Bytes mic;
     std::vector<Bytes> pmkids;
+    double ts = 0.0;      // capture timestamp, epoch seconds
+    bool has_ts = false;  // pcapng SPBs carry no timestamp
 };
 
 struct Frame {
     const uint8_t* p;
     size_t n;
+    double ts;
+    bool has_ts;
 };
 
 // ---- container readers --------------------------------------------------
@@ -85,16 +89,42 @@ void pcap_frames(const uint8_t* d, size_t len, std::vector<Frame>& frames,
         be = true;
     else
         return;
+    // Nanosecond-resolution magics (a1b23c4d and its byte swap).
+    bool nsec = !memcmp(d, "\xa1\xb2\x3c\x4d", 4) || !memcmp(d, "\x4d\x3c\xb2\xa1", 4);
+    double frac = nsec ? 1e-9 : 1e-6;
     uint32_t linktype = rd32(d + 20, be) & 0xFFFF;
     size_t off = 24;
     while (off + 16 <= len) {
+        uint32_t sec = rd32(d + off, be);
+        uint32_t sub = rd32(d + off + 4, be);
         uint32_t caplen = rd32(d + off + 8, be);
         off += 16;
         if (off + caplen > len) break;
-        frames.push_back({d + off, caplen});
+        frames.push_back({d + off, caplen, sec + sub * frac, true});
         linktypes.push_back(linktype);
         off += caplen;
     }
+}
+
+// seconds per timestamp unit from an IDB's if_tsresol option (code 9)
+double idb_tsresol(const uint8_t* body, size_t bodylen, bool be) {
+    size_t off = 8;  // linktype(2) + reserved(2) + snaplen(4)
+    while (off + 4 <= bodylen) {
+        uint16_t code = rd16(body + off, be), ln = rd16(body + off + 2, be);
+        if (code == 0) break;  // opt_endofopt
+        if (code == 9 && ln >= 1 && off + 4 < bodylen) {
+            uint8_t v = body[off + 4];
+            double r = 1.0;
+            if (v & 0x80) {
+                for (int i = 0; i < (v & 0x7F); i++) r /= 2.0;
+            } else {
+                for (int i = 0; i < (v & 0x7F); i++) r /= 10.0;
+            }
+            return r;
+        }
+        off += 4 + ln + ((4 - ln % 4) % 4);
+    }
+    return 1e-6;
 }
 
 void pcapng_frames(const uint8_t* d, size_t len, std::vector<Frame>& frames,
@@ -102,7 +132,7 @@ void pcapng_frames(const uint8_t* d, size_t len, std::vector<Frame>& frames,
     if (len < 12 || memcmp(d, "\x0a\x0d\x0d\x0a", 4)) return;
     bool be = !(len >= 12 && !memcmp(d + 8, "\x4d\x3c\x2b\x1a", 4));
     size_t off = 0;
-    std::vector<uint32_t> ifaces;
+    std::vector<std::pair<uint32_t, double>> ifaces;  // (linktype, tsresol)
     while (off + 12 <= len) {
         uint32_t btype = rd32(d + off, be);
         uint32_t blen = rd32(d + off + 4, be);
@@ -110,18 +140,21 @@ void pcapng_frames(const uint8_t* d, size_t len, std::vector<Frame>& frames,
         const uint8_t* body = d + off + 8;
         size_t bodylen = blen - 12;
         if (btype == 0x00000001 && bodylen >= 2) {  // IDB
-            ifaces.push_back(rd16(body, be));
+            ifaces.emplace_back(rd16(body, be), idb_tsresol(body, bodylen, be));
         } else if (btype == 0x00000006 && bodylen >= 20) {  // EPB
             uint32_t iface = rd32(body, be);
+            uint32_t tsh = rd32(body + 4, be), tsl = rd32(body + 8, be);
             uint32_t caplen = rd32(body + 12, be);
             if (caplen > bodylen - 20) caplen = bodylen - 20;
-            frames.push_back({body + 20, caplen});
-            linktypes.push_back(iface < ifaces.size() ? ifaces[iface] : 105);
-        } else if (btype == 0x00000003 && bodylen >= 4) {  // SPB
+            double res = iface < ifaces.size() ? ifaces[iface].second : 1e-6;
+            double ts = (double)(((uint64_t)tsh << 32) | tsl) * res;
+            frames.push_back({body + 20, caplen, ts, true});
+            linktypes.push_back(iface < ifaces.size() ? ifaces[iface].first : 105);
+        } else if (btype == 0x00000003 && bodylen >= 4) {  // SPB: no timestamp
             uint32_t caplen = rd32(body, be);
             if (caplen > bodylen - 4) caplen = bodylen - 4;
-            frames.push_back({body + 4, caplen});
-            linktypes.push_back(ifaces.empty() ? 105 : ifaces[0]);
+            frames.push_back({body + 4, caplen, 0.0, false});
+            linktypes.push_back(ifaces.empty() ? 105 : ifaces[0].first);
         }
         off += blen;
     }
@@ -130,12 +163,12 @@ void pcapng_frames(const uint8_t* d, size_t len, std::vector<Frame>& frames,
 // strip link-layer wrappers; returns empty frame to drop
 Frame unwrap(Frame f, uint32_t lt) {
     if (lt == 127 || lt == 192) {  // radiotap / PPI: LE length at offset 2
-        if (f.n < 4) return {nullptr, 0};
+        if (f.n < 4) return {nullptr, 0, 0.0, false};
         uint16_t hl = rd16(f.p + 2, false);
-        if (hl > f.n) return {nullptr, 0};
-        return {f.p + hl, f.n - hl};
+        if (hl > f.n) return {nullptr, 0, 0.0, false};
+        return {f.p + hl, f.n - hl, f.ts, f.has_ts};
     }
-    if (lt != 105) return {nullptr, 0};
+    if (lt != 105) return {nullptr, 0, 0.0, false};
     return f;
 }
 
@@ -250,8 +283,8 @@ std::string serialize(int type, const Bytes& mic, const Bytes& ap,
 
 extern "C" {
 
-int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint, char** out,
-                 size_t* out_len) {
+int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint,
+                 double eapol_timeout_s, char** out, size_t* out_len) {
     if (!blob || !out || !out_len) return -1;
     std::vector<Frame> raw;
     std::vector<uint32_t> lts;
@@ -327,6 +360,8 @@ int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint, char** out,
 
         EapolMsg m;
         if (!parse_eapol_key(ap, sta, eapol, elen, m)) continue;
+        m.ts = f.ts;
+        m.has_ts = f.has_ts;
         Bytes key = ap + sta;
         (m.num == 1 || m.num == 3 ? ap_msgs : sta_msgs).get(key).push_back(m);
         if (m.num == 1 || m.num == 3) ap_nonces.get(ap).push_back(m.nonce);
@@ -407,6 +442,13 @@ int dwpa_extract(const uint8_t* blob, size_t len, int nc_hint, char** out,
                 for (auto& am : *aps) {
                     if (am.num != pr.ap_num) continue;
                     if ((int64_t)(am.replay - sm.replay) != pr.delta) continue;
+                    // --eapoltimeout gate (web/common.php:481): messages
+                    // captured too far apart are different exchanges.
+                    if (am.has_ts && sm.has_ts) {
+                        double dt = am.ts - sm.ts;
+                        if (dt < 0) dt = -dt;
+                        if (dt > eapol_timeout_s) continue;
+                    }
                     int mp = pr.mp | (nc_hint ? 0x80 : 0) | endian_bits(ap);
                     text += "H " +
                             serialize(2, sm.mic, ap, sm.sta, essid, am.nonce,

@@ -113,7 +113,7 @@ def parse_rules(lines, on_error: str = "skip"):
     return out
 
 
-def apply_rules(rules, words, workers: int = 0):
+def apply_rules(rules, words, workers: int = 0, force_pool: bool = False):
     """Expand: yield every (rule, word) mangling, skipping rejects.
 
     Order matches hashcat --stdout: for each word, each rule in file
@@ -124,11 +124,31 @@ def apply_rules(rules, words, workers: int = 0):
     order-preserving chunks: single-process expansion sustains ~0.8M
     cand/s, enough to feed one v5e chip (~230k PMK/s) but not a mesh
     (SURVEY §7.3.3 "keeping the device fed"); the pool scales the host
-    side roughly linearly until packing/H2D dominates.
+    side roughly linearly until packing/H2D dominates — PROVIDED the
+    host has cores to spare.  On a host with fewer than ``workers + 1``
+    cores the pool contends with the feeding process and measures
+    *slower* than serial (2-core container: 769k pooled vs 995k serial,
+    BENCH_r03 host_feed), so ``--rule-workers`` is auto-ignored there
+    with a warning; ``force_pool`` overrides the guard (benchmarks use
+    it to keep tracking the true pooled rate).
     """
     if workers and workers > 1:
-        yield from _apply_rules_pooled(rules, words, workers)
-        return
+        ncpu = _usable_cpus()
+        if force_pool or ncpu >= workers + 1:
+            yield from _apply_rules_pooled(rules, words, workers)
+            return
+        if workers not in _POOL_GUARD_WARNED:
+            # once per (process, worker count): the condition can't
+            # change at runtime and a client hits this per dict stream
+            _POOL_GUARD_WARNED.add(workers)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "rule-expansion pool disabled: %d workers need %d cores, host "
+                "has %d (pooled expansion measures slower than serial when "
+                "the pool contends with the feed process)",
+                workers, workers + 1, ncpu,
+            )
     for word in words:
         for rule in rules:
             w = rule.apply(word)
@@ -138,6 +158,20 @@ def apply_rules(rules, words, workers: int = 0):
 
 _WORKER_RULES = {}  # worker-side: rules-key -> parsed [Rule]
 _POOLS = {}         # parent-side: worker count -> live Pool (reused)
+_POOL_GUARD_WARNED = set()  # worker counts already warned about
+
+
+def _usable_cpus() -> int:
+    """CPUs this process may actually run on — sched_getaffinity sees
+    cgroup/cpuset pins that os.cpu_count() (whole-machine) does not,
+    and a 2-core-pinned container on a 64-core host is exactly where
+    the pool guard must trip."""
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):  # non-Linux
+        return os.cpu_count() or 1
 
 
 def _pool_expand(args):

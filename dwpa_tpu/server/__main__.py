@@ -29,7 +29,7 @@ def _load_conf(args):
     with open(path) as f:
         conf = json.load(f)
     for key in ("db", "dictdir", "capdir", "hcdir", "bosskey", "host",
-                "port", "base_url"):
+                "port", "base_url", "capture_cap"):
         if key in conf and getattr(args, key, None) is None:
             setattr(args, key, conf[key])
     return conf
@@ -49,6 +49,7 @@ def _core(args):
         bosskey=getattr(args, "bosskey", None),
         hcdir=getattr(args, "hcdir", None),
         base_url=getattr(args, "base_url", None) or "",
+        capture_cap=getattr(args, "capture_cap", None),
     )
     if getattr(args, "recaptcha_secret", None):
         from .external import RECAPTCHA_URL, RecaptchaVerifier
@@ -353,6 +354,10 @@ def main(argv=None):
     sp.add_argument("--bosskey", help="32-hex superuser key (conf.php)")
     sp.add_argument("--hcdir", help="client-distribution dir (web/hc/): "
                                     "dwpa_tpu.version + dwpa_tpu.pyz")
+    sp.add_argument("--capture-cap", dest="capture_cap", type=int, default=None,
+                    help="capture upload size bound in bytes, raw and "
+                         "gzip-decompressed (default 8 MiB — the reference's "
+                         "deployment-tunable PHP upload limit)")
     sp.add_argument("--with-jobs", action="store_true",
                     help="run the cron layer as a background thread of "
                          "this process (single-process deployment)")

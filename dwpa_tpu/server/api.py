@@ -73,8 +73,15 @@ def _clear_key_cookie():
 # Capture uploads get a much tighter body cap than the JSON/form routes:
 # the reference runs behind PHP upload limits (typically single-digit MiB),
 # and a 64 MiB cap x 16 concurrent workers would bound worst-case hostile
-# upload memory at 1 GiB.  8 MiB holds any real-world capture.
+# upload memory at 1 GiB.  8 MiB holds any real-world capture; deployments
+# with longer captures raise it per-core (ServerCore(capture_cap=...),
+# ``serve --capture-cap``) without patching this default.
 CAPTURE_BODY_CAP = 8 * 1024 * 1024
+
+
+def _capture_cap(core) -> int:
+    cap = getattr(core, "capture_cap", None)
+    return CAPTURE_BODY_CAP if cap is None else int(cap)
 
 
 def _parse_multipart(body: bytes, ctype: str):
@@ -103,10 +110,13 @@ def _parse_multipart(body: bytes, ctype: str):
         if content.endswith(b"\r\n"):
             content = content[:-2]
         headers = head.decode("latin1")
-        mname = re.search(r'name="([^"]*)"', headers)
+        # Anchor ``name=`` to a parameter boundary: a bare name="..."
+        # search would also match the tail of ``filename="..."``, so a
+        # part ordered ``filename= ... name=`` would lose its real name.
+        mname = re.search(r'(?:^|[;\s])name="([^"]*)"', headers)
         if not mname:
             continue
-        mfile = re.search(r'filename="([^"]*)"', headers)
+        mfile = re.search(r'(?:^|[;\s])filename="([^"]*)"', headers)
         if mfile:
             files[mname.group(1)] = (mfile.group(1), content)
         else:
@@ -215,7 +225,7 @@ def _route(core: ServerCore, environ):
         # - multipart/form-data from the browser submit form
         #   (content/submit.php:18-31) — the capture is the first file
         #   part (the form names it "file").
-        blob = _read_body(environ, cap=CAPTURE_BODY_CAP)
+        blob = _read_body(environ, cap=_capture_cap(core))
         userkey = qs.get("key", [None])[0]
         ctype = environ.get("CONTENT_TYPE", "")
         if ctype.startswith("multipart/form-data"):
@@ -380,17 +390,18 @@ def submit_capture(core: ServerCore, blob: bytes, ip: str = "",
     """
     if blob[:2] == b"\x1f\x8b":
         # Bounded decompression: an 8 MiB gzip bomb inflates ~1000x, so
-        # an unbounded gzip.decompress would defeat CAPTURE_BODY_CAP's
+        # an unbounded gzip.decompress would defeat the capture cap's
         # whole point (the hostile-upload memory bound).  The cap applies
         # to the decompressed capture too — no real pcap needs more.
         import io
 
+        cap = _capture_cap(core)
         try:
             with gzip.GzipFile(fileobj=io.BytesIO(blob)) as gf:
-                blob = gf.read(CAPTURE_BODY_CAP + 1)
+                blob = gf.read(cap + 1)
         except (OSError, EOFError):
             raise ValueError("bad gzip")
-        if len(blob) > CAPTURE_BODY_CAP:
+        if len(blob) > cap:
             raise BodyTooLarge(len(blob))
     s_id = core.add_submission(blob, ip=ip)
     if blob[:4].lstrip()[:3] == b"WPA":

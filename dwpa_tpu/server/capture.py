@@ -56,17 +56,34 @@ def _pcap_frames(data: bytes):
         endian = ">"
     else:
         raise ValueError("not a pcap file")
+    # The nanosecond-resolution magics (a1b23c4d and its byte swap).
+    frac = 1e-9 if magic in (b"\xa1\xb2\x3c\x4d", b"\x4d\x3c\xb2\xa1") else 1e-6
     if len(data) < 24:
         return  # truncated global header: no frames, not a crash
     linktype = struct.unpack_from(endian + "I", data, 20)[0] & 0xFFFF
     off = 24
     while off + 16 <= len(data):
-        _, _, caplen, _ = struct.unpack_from(endian + "IIII", data, off)
+        sec, sub, caplen, _ = struct.unpack_from(endian + "IIII", data, off)
         off += 16
         if off + caplen > len(data):
             break
-        yield linktype, data[off : off + caplen]
+        yield linktype, sec + sub * frac, data[off : off + caplen]
         off += caplen
+
+
+def _if_tsresol(body: bytes, endian: str) -> float:
+    """Seconds per timestamp unit from an IDB's if_tsresol option (code 9,
+    default 10^-6; high bit set means a power-of-two resolution)."""
+    off = 8  # linktype(2) + reserved(2) + snaplen(4)
+    while off + 4 <= len(body):
+        code, ln = struct.unpack_from(endian + "HH", body, off)
+        if code == 0:  # opt_endofopt
+            break
+        if code == 9 and ln >= 1 and off + 4 < len(body):
+            v = body[off + 4]
+            return 2.0 ** -(v & 0x7F) if v & 0x80 else 10.0 ** -(v & 0x7F)
+        off += 4 + ln + ((-ln) % 4)
+    return 1e-6
 
 
 def _pcapng_frames(data: bytes):
@@ -74,33 +91,39 @@ def _pcapng_frames(data: bytes):
         raise ValueError("not a pcapng file")
     endian = "<" if data[8:12] == b"\x4d\x3c\x2b\x1a" else ">"
     off = 0
-    ifaces = []
+    ifaces = []  # (linktype, seconds-per-ts-unit)
     while off + 12 <= len(data):
         btype, blen = struct.unpack_from(endian + "II", data, off)
         if blen < 12 or off + blen > len(data):
             break
         body = data[off + 8 : off + blen - 4]
         if btype == 0x00000001 and len(body) >= 2:  # IDB
-            ifaces.append(struct.unpack_from(endian + "H", body, 0)[0])
+            ifaces.append((struct.unpack_from(endian + "H", body, 0)[0],
+                           _if_tsresol(body, endian)))
         elif btype == 0x00000006 and len(body) >= 20:  # EPB
-            iface, _, _, caplen, _ = struct.unpack_from(endian + "IIIII", body, 0)
+            iface, tsh, tsl, caplen, _ = struct.unpack_from(
+                endian + "IIIII", body, 0
+            )
             frame = body[20 : 20 + caplen]
-            lt = ifaces[iface] if iface < len(ifaces) else DLT_IEEE802_11
-            yield lt, frame
+            lt, res = (ifaces[iface] if iface < len(ifaces)
+                       else (DLT_IEEE802_11, 1e-6))
+            yield lt, ((tsh << 32) | tsl) * res, frame
         elif btype == 0x00000003 and len(body) >= 4:  # Simple Packet Block
-            lt = ifaces[0] if ifaces else DLT_IEEE802_11
+            lt = ifaces[0][0] if ifaces else DLT_IEEE802_11
             caplen = struct.unpack_from(endian + "I", body, 0)[0]
-            yield lt, body[4 : 4 + caplen]
+            yield lt, None, body[4 : 4 + caplen]  # SPB carries no timestamp
         off += blen
 
 
 def iter_frames(data: bytes):
-    """Yield (linktype, 802.11-frame) from a pcap or pcapng blob."""
+    """Yield (timestamp-seconds-or-None, 802.11-frame) from a pcap or
+    pcapng blob.  The timestamp (epoch seconds, float) feeds the EAPOL
+    pairing time gate; pcapng Simple Packet Blocks carry none."""
     if data[:4] == b"\x0a\x0d\x0d\x0a":
         src = _pcapng_frames(data)
     else:
         src = _pcap_frames(data)
-    for lt, frame in src:
+    for lt, ts, frame in src:
         if lt == DLT_RADIOTAP:
             if len(frame) < 4:
                 continue
@@ -114,7 +137,7 @@ def iter_frames(data: bytes):
         elif lt != DLT_IEEE802_11:
             continue
         if frame:
-            yield frame
+            yield ts, frame
 
 
 # ---------------------------------------------------------------------------
@@ -133,6 +156,7 @@ class EapolMsg:
     frame: bytes             # full EAPOL frame, MIC zeroed
     mic: bytes
     pmkids: list = field(default_factory=list)
+    ts: float = None         # capture timestamp (epoch s), None if unknown
 
 
 def _tagged_ssid(body: bytes, off: int):
@@ -261,11 +285,22 @@ _PAIRINGS = [
 ]
 
 
-def extract_hashlines(blob: bytes, nc_hint: bool = True):
+#: Max inter-frame gap for M1/M2 (and the other pairings) to count as one
+#: handshake exchange — the reference's hcxpcapngtool invocation passes
+#: --eapoltimeout=30000 ms (web/common.php:481).  Without the gate, a long
+#: capture in which replay counters recur across sessions can pair a MIC
+#: with an ANONCE from a *different* exchange, emitting uncrackable junk.
+EAPOL_TIMEOUT_S = 30.0
+
+
+def extract_hashlines(blob: bytes, nc_hint: bool = True,
+                      eapol_timeout: float = EAPOL_TIMEOUT_S):
     """Capture blob -> ([m22000 hashline str, ...], [probe-request ssid, ...]).
 
     Deduped: one PMKID line per (ap, sta, pmkid); the best EAPOL pairing
-    per (ap, sta) in _PAIRINGS preference order.
+    per (ap, sta) in _PAIRINGS preference order, restricted to message
+    pairs captured within ``eapol_timeout`` seconds of each other
+    (frames without timestamps — pcapng SPBs — are never gated).
     """
     essids = defaultdict(Counter)       # ap -> Counter[ssid]
     probes = []
@@ -275,7 +310,7 @@ def extract_hashlines(blob: bytes, nc_hint: bool = True):
     pmkid_seen = set()
     pmkid_rows = []
 
-    for frame in iter_frames(blob):
+    for ts, frame in iter_frames(blob):
         try:
             parsed = parse_80211(frame)
         except (struct.error, IndexError):
@@ -291,6 +326,7 @@ def extract_hashlines(blob: bytes, nc_hint: bool = True):
                 probes.append(payload)
         else:
             msg = payload
+            msg.ts = ts
             bucket = ap_msgs if msg.num in (1, 3) else sta_msgs
             bucket[(msg.ap, msg.sta)].append(msg)
             if msg.num in (1, 3):
@@ -362,6 +398,9 @@ def extract_hashlines(blob: bytes, nc_hint: bool = True):
                 for am in aps:
                     if am.num != ap_num or am.replay - sm.replay != delta:
                         continue
+                    if (am.ts is not None and sm.ts is not None
+                            and abs(am.ts - sm.ts) > eapol_timeout):
+                        continue  # different exchanges, not a handshake
                     mp_final = mp | (0x80 if nc_hint else 0) | endian_bits(ap)
                     lines.append(
                         hl.serialize(

@@ -126,10 +126,16 @@ def valid_email(mail: str) -> bool:
 class ServerCore:
     def __init__(self, db: Database, dictdir: str = None, capdir: str = None,
                  mailer=None, bosskey: str = None, captcha=None,
-                 base_url: str = "", hcdir: str = None):
+                 base_url: str = "", hcdir: str = None,
+                 capture_cap: int = None):
         self.db = db
         self.dictdir = dictdir
         self.capdir = capdir
+        # Upload size bound for captures (raw AND gzip-decompressed);
+        # None -> api.CAPTURE_BODY_CAP's 8 MiB default.  The reference's
+        # analog is the PHP upload limit — deployment-tunable, so this
+        # is too (serve --capture-cap).
+        self.capture_cap = capture_cap
         self.hcdir = hcdir            # client-distribution dir (web/hc/)
         self.mailer = mailer          # mail.Mailer or None (delivery skipped)
         self.bosskey = bosskey        # 32-hex superuser key (conf.php)

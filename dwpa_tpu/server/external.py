@@ -24,6 +24,7 @@ response), while a successful-but-empty answer is a definitive
 """
 
 import json
+import os
 import time
 import urllib.error
 import urllib.parse
@@ -235,9 +236,13 @@ def _nslookup_mx(domain: str) -> bool:
     exe = shutil.which("nslookup")
     if exe is None:
         return True  # no resolver tooling: fail open
+    # LANG/LC_ALL=C: _parse_mx_output matches English resolver strings
+    # ("can't find", "non-existent domain") — under a non-English locale
+    # the negatives would never match and every probe would fail open.
     out = subprocess.run(
         [exe, "-type=MX", domain + "."],
         capture_output=True, text=True, timeout=10,
+        env={**os.environ, "LANG": "C", "LC_ALL": "C"},
     )
     return _parse_mx_output(out.stdout + out.stderr)
 

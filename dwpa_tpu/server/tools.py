@@ -324,10 +324,23 @@ def reorder_captures(core: ServerCore, capdir: str = None) -> dict:
         dst = os.path.join(dstdir, name)
         shutil.move(src, dst)
         moved += 1
+        # Match rows by the md5 basename, not the exact joined path: the
+        # server may have stored a different capdir spelling (relative
+        # "caps" vs absolute, trailing slash) than this CLI was given,
+        # and an exact-match UPDATE would move the file but leave the
+        # DB row pointing at the old location.
         updated += core.db.x(
-            "UPDATE submissions SET localfile = ? WHERE localfile = ?",
-            (dst, src),
+            "UPDATE submissions SET localfile = ? "
+            "WHERE localfile = ? OR localfile LIKE ?",
+            (dst, src, "%/" + name),
         ).rowcount
+    if moved != updated:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "reorder_captures: moved %d files but updated %d submissions "
+            "rows — some captures have no (or multiple) DB rows", moved, updated,
+        )
     return {"moved": moved, "db_updated": updated}
 
 

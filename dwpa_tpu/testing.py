@@ -160,23 +160,33 @@ def probe_request_frame(sta: bytes, essid: bytes) -> bytes:
 
 
 def pcap_bytes(frames, linktype: int = 105, endian: str = "<",
-               nsec: bool = False) -> bytes:
+               nsec: bool = False, times=None) -> bytes:
     """Wrap raw 802.11 frames in a classic pcap container.
 
     ``endian``: '<' (the common case) or '>' (big-endian writer);
-    ``nsec``: use the nanosecond-resolution magic.  Exercises every
+    ``nsec``: use the nanosecond-resolution magic.  ``times``: per-frame
+    epoch seconds (float ok; default: 1 s apart) — the knob for
+    exercising the --eapoltimeout pairing gate.  Exercises every
     container variant server/capture.py accepts.
     """
     magic = 0xA1B23C4D if nsec else 0xA1B2C3D4
+    res = 1e9 if nsec else 1e6
     out = struct.pack(endian + "IHHiIII", magic, 2, 4, 0, 0, 65535, linktype)
     for i, fr in enumerate(frames):
-        out += struct.pack(endian + "IIII", 1700000000 + i, 0, len(fr), len(fr)) + fr
+        t = (1700000000 + i) if times is None else times[i]
+        sec = int(t)
+        sub = round((t - sec) * res)
+        out += struct.pack(endian + "IIII", sec, sub, len(fr), len(fr)) + fr
     return out
 
 
 def pcapng_bytes(frames, linktype: int = 105, endian: str = "<",
-                 simple: bool = False) -> bytes:
-    """Wrap frames in a pcapng container (SHB + IDB + EPB/SPB blocks)."""
+                 simple: bool = False, times=None) -> bytes:
+    """Wrap frames in a pcapng container (SHB + IDB + EPB/SPB blocks).
+
+    ``times``: per-frame epoch seconds for EPBs (default 1 s apart,
+    microsecond units — the pcapng default resolution); SPBs carry no
+    timestamp by design."""
     def block(btype: int, body: bytes) -> bytes:
         pad = (-len(body)) % 4
         total = 12 + len(body) + pad
@@ -187,11 +197,14 @@ def pcapng_bytes(frames, linktype: int = 105, endian: str = "<",
     shb = block(0x0A0D0D0A, bom + struct.pack(endian + "HHq", 1, 0, -1))
     idb = block(0x00000001, struct.pack(endian + "HHI", linktype, 0, 65535))
     out = shb + idb
-    for fr in frames:
+    for i, fr in enumerate(frames):
         if simple:
             out += block(0x00000003, struct.pack(endian + "I", len(fr)) + fr)
         else:
-            body = struct.pack(endian + "IIIII", 0, 0, 0, len(fr), len(fr)) + fr
+            t = (1700000000 + i) if times is None else times[i]
+            units = round(t * 1e6)
+            body = struct.pack(endian + "IIIII", 0, (units >> 32) & 0xFFFFFFFF,
+                               units & 0xFFFFFFFF, len(fr), len(fr)) + fr
             out += block(0x00000006, body)
     return out
 

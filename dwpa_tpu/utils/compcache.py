@@ -1,0 +1,38 @@
+"""Persistent XLA compilation cache wiring.
+
+The first compile of the 4096-iteration PBKDF2 step costs ~20-40 s on
+TPU; per process that was paid once per (batch, width) signature, but a
+freshly restarted client paid it again before its first work unit — the
+dominant term in cold-start latency (the reference client has no analog:
+hashcat ships precompiled GPU kernels).  JAX's persistent compilation
+cache turns that into a disk hit across restarts.
+
+Separate module (not utils/__init__) so importing it never drags jax in
+before ``jax.distributed.initialize`` runs on multi-host clients.
+"""
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns True when enabled.  Best-effort: an unwritable directory or
+    a jax build without the feature logs and moves on — the cache is a
+    cold-start optimization, never a requirement.  The 0.5 s floor keeps
+    trivial host-side jits (reshapes, the replicate identity) out of the
+    cache while every kernel that matters (all >1 s) persists.
+    """
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        return True
+    except Exception as e:  # pragma: no cover - depends on jax build
+        log.warning("persistent compilation cache unavailable: %s", e)
+        return False

@@ -24,3 +24,11 @@ if os.environ.get("DWPA_TEST_TPU") != "1":
 
     jax.config.update("jax_platforms", "cpu")
     assert len(jax.devices()) == 8, jax.devices()
+
+# Persist XLA compilations across suite runs: the heavyweight shard_map
+# steps dominate suite wall-clock and their HLO is identical run-to-run.
+from dwpa_tpu.utils.compcache import enable_compilation_cache
+
+enable_compilation_cache(
+    os.path.join(os.path.dirname(__file__), "..", ".pytest_xla_cache")
+)

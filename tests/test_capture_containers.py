@@ -241,3 +241,108 @@ def test_parser_survives_garbage_and_mutations():
             hl.parse(ln)                             # anything emitted parses
     # (the native parser gets the same blobs differentially in
     # tests/test_native_capture.py's fuzz loops)
+
+
+# ---------------------------------------------------------------------------
+# --eapoltimeout pairing gate (web/common.php:481)
+
+
+def _interleaved_sessions_frames(seed="eto"):
+    """Two handshake sessions, same (ap, sta), same replay counter, far
+    apart in time.  Session A contributes only an M1 (anonce_a); session
+    B is complete (M1 anonce_b + M2 whose MIC is real over anonce_b).
+    An ungated parser pairs B's M2 with A's M1 — first in _PAIRINGS scan
+    order — and emits a line whose MIC can never verify."""
+    mac_ap = tfx._rand(seed + "ap", 6)
+    mac_sta = tfx._rand(seed + "sta", 6)
+    anonce_a = tfx._rand(seed + "anonceA", 32)
+    anonce_b = tfx._rand(seed + "anonceB", 32)
+    snonce = tfx._rand(seed + "snonce", 32)
+    pmk = oracle.pmk_from_psk(PSK, ESSID)
+
+    m1_a = tfx.build_eapol_key_frame(0x008A, 1, anonce_a)
+    m1_b = tfx.build_eapol_key_frame(0x008A, 1, anonce_b)
+    m2_zero = tfx.build_eapol_key_frame(0x010A, 1, snonce,
+                                        key_data=tfx._rand(seed + "rsn", 22))
+    m = min(mac_ap, mac_sta) + max(mac_ap, mac_sta)
+    n = snonce + anonce_b if snonce[:6] < anonce_b[:6] else anonce_b + snonce
+    mic = oracle.compute_mic(pmk, 2, m, n, m2_zero)
+    m2 = m2_zero[:81] + mic + m2_zero[97:]
+
+    frames = [
+        tfx.beacon_frame(mac_ap, ESSID),
+        tfx._dot11_data_eapol(mac_ap, mac_sta, mac_ap, m1_a, from_ds=True),
+        tfx._dot11_data_eapol(mac_ap, mac_sta, mac_ap, m1_b, from_ds=True),
+        tfx._dot11_data_eapol(mac_sta, mac_ap, mac_ap, m2, from_ds=False),
+    ]
+    t0 = 1700000000
+    times = [t0, t0, t0 + 100.0, t0 + 100.5]  # A's M1 100 s before B
+    return frames, times, anonce_b
+
+
+def test_eapoltimeout_rejects_cross_session_pairing():
+    frames, times, anonce_b = _interleaved_sessions_frames()
+    lines, _ = extract_hashlines(tfx.pcap_bytes(frames, times=times))
+    eapols = [hl.parse(x) for x in lines
+              if hl.parse(x).hash_type == hl.TYPE_EAPOL]
+    # Exactly one line, paired within the same session: crackable.
+    assert len(eapols) == 1
+    assert eapols[0].anonce == anonce_b
+    assert oracle.check_key_m22000(eapols[0], [PSK]) is not None
+
+
+def test_eapoltimeout_disabled_shows_the_junk_line():
+    """Sanity check on the fixture: with the gate off, the scan pairs
+    A's stale M1 first and the emitted line is uncrackable junk."""
+    frames, times, anonce_b = _interleaved_sessions_frames()
+    lines, _ = extract_hashlines(tfx.pcap_bytes(frames, times=times),
+                                 eapol_timeout=float("inf"))
+    eapols = [hl.parse(x) for x in lines
+              if hl.parse(x).hash_type == hl.TYPE_EAPOL]
+    assert len(eapols) == 1
+    assert eapols[0].anonce != anonce_b
+    assert oracle.check_key_m22000(eapols[0], [PSK]) is None
+
+
+def test_eapoltimeout_pcapng_and_native_agree():
+    """Differential: the C++ twin applies the identical gate, in both
+    containers (pcapng EPB timestamps use if_tsresol units)."""
+    from dwpa_tpu import native
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native capture library unavailable")
+    frames, times, _ = _interleaved_sessions_frames()
+    for blob in (tfx.pcap_bytes(frames, times=times),
+                 tfx.pcap_bytes(frames, times=times, nsec=True, endian=">"),
+                 tfx.pcapng_bytes(frames, times=times)):
+        py = extract_hashlines(blob)
+        assert native.extract_hashlines_fast(blob) == py
+        py_off = extract_hashlines(blob, eapol_timeout=1e9)
+        assert native.extract_hashlines_fast(blob, eapol_timeout=1e9) == py_off
+        assert py != py_off  # the gate actually changed the outcome
+
+
+def test_pcapng_truncated_tsresol_option_no_crash():
+    """An IDB whose if_tsresol option header declares a value byte the
+    body doesn't contain must parse to nothing, not crash (hostile
+    uploads reach this parser unauthenticated) — and the native twin
+    must agree."""
+    import struct as st
+
+    from dwpa_tpu import native
+
+    def block(btype, body):
+        pad = (-len(body)) % 4
+        total = 12 + len(body) + pad
+        return (st.pack("<II", btype, total) + body + b"\x00" * pad
+                + st.pack("<I", total))
+
+    shb = block(0x0A0D0D0A, st.pack("<I", 0x1A2B3C4D) + st.pack("<HHq", 1, 0, -1))
+    idb = block(0x00000001,
+                st.pack("<HHI", 105, 0, 65535) + st.pack("<HH", 9, 1))
+    blob = shb + idb
+    assert extract_hashlines(blob) == ([], [])
+    if native.load() is not None:
+        assert native.extract_hashlines_fast(blob) == ([], [])

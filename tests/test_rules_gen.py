@@ -209,5 +209,31 @@ def test_apply_rules_pooled_matches_serial():
     rules = parse_rules([":", "c", "$1", "se3", "r", "] ]"])
     words = [b"poolword%04d" % i for i in range(500)]
     serial = list(apply_rules(rules, words))
-    pooled = list(apply_rules(rules, iter(words), workers=3))
+    # force_pool: the few-cores guard must not silently serialize the
+    # very path this test exists to pin.
+    pooled = list(apply_rules(rules, iter(words), workers=3, force_pool=True))
     assert pooled == serial
+
+
+def test_apply_rules_pool_guard_falls_back_serial(monkeypatch, caplog):
+    """On a host without spare cores the pool is auto-disabled (with a
+    warning) and the serial stream is produced instead — --rule-workers
+    must never make a deployment slower (BENCH_r03 host_feed)."""
+    import logging
+
+    from dwpa_tpu.rules import apply_rules, parse_rules
+    from dwpa_tpu.rules import engine as eng
+
+    rules = parse_rules([":", "u", "$9"])
+    words = [b"guardword%02d" % i for i in range(20)]
+    monkeypatch.setattr(eng, "_usable_cpus", lambda: 2)
+    monkeypatch.setattr(eng, "_POOL_GUARD_WARNED", set())
+
+    def boom(*a, **k):  # the pool must not even be touched
+        raise AssertionError("pool used despite guard")
+
+    monkeypatch.setattr(eng, "_apply_rules_pooled", boom)
+    with caplog.at_level(logging.WARNING, logger="dwpa_tpu.rules.engine"):
+        out = list(apply_rules(rules, words, workers=8))
+    assert out == list(apply_rules(rules, words))
+    assert any("pool disabled" in r.message for r in caplog.records)
