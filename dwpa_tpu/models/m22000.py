@@ -385,6 +385,24 @@ class _PackedWords:
         return bo.words_to_bytes_be(self.words[b])[: int(self.lens[b])]
 
 
+class _RuleWords:
+    """pws view for a device-mangled batch: column ``b`` decodes by
+    applying the host rule to the base word — the executable spec — so
+    hit decode never trusts the device transform."""
+
+    __slots__ = ("base", "rule")
+
+    def __init__(self, base, rule):
+        self.base = base
+        self.rule = rule
+
+    def __getitem__(self, b):
+        out = self.rule.apply(self.base[b])
+        if out is None or not MIN_PSK_LEN <= len(out) <= MAX_PSK_LEN:
+            return None  # rejected/out-of-range: column was zeroed on device
+        return out
+
+
 class _Pipeline:
     """Shared dispatch/sync pipeline for the engine's crack paths.
 
@@ -470,6 +488,7 @@ class M22000Engine:
         # signature — parallel/step.py — so building a step is cheap.)
         self._full = {}   # essid -> original list[PreppedNet]
         self._steps = {}  # essid -> crack step (parallel.build_crack_step)
+        self._rules_steps = {}  # essid -> fused rules step (build_rules_step)
         # Per-stage wall-clock accumulators (SURVEY.md §5.1): host pack +
         # H2D enqueue / device dispatch / sync + decode.  "collect" is
         # where device compute surfaces under the async runtime.
@@ -499,6 +518,7 @@ class M22000Engine:
             del self.groups[found.line.essid]
             del self._salts[found.line.essid]
             self._steps.pop(found.line.essid, None)
+            self._rules_steps.pop(found.line.essid, None)
             self._full.pop(found.line.essid, None)
 
     def _step_for(self, essid: bytes):
@@ -511,6 +531,18 @@ class M22000Engine:
             s1, s2 = self._salts[essid]
             step = build_crack_step(self.mesh, list(self._full[essid]), s1, s2)
             self._steps[essid] = step
+        return step
+
+    def _rules_step_for(self, essid: bytes):
+        """The fused expand+crack step (build_rules_step) for one ESSID
+        group — same full-membership / lifetime contract as _step_for."""
+        from ..parallel.step import build_rules_step
+
+        step = self._rules_steps.get(essid)
+        if step is None:
+            s1, s2 = self._salts[essid]
+            step = build_rules_step(self.mesh, list(self._full[essid]), s1, s2)
+            self._rules_steps[essid] = step
         return step
 
     def _prepare(self, passwords):
@@ -707,6 +739,97 @@ class M22000Engine:
             })
         return found, pmk_host, psk_by_col
 
+    def _decode(self, group, found, pmk_col, pws, psk_by_col, live) -> list:
+        """Decode one found matrix ([N, V_max, B]) into Found records.
+
+        ``pmk_col(b) -> uint32[8]`` resolves a column's PMK words (a
+        dense host matrix or the sparse gathered view — see _collect).
+        ``live`` is a mutable id-set shared across a batch's decodes (a
+        chunked rules dispatch carries several matrices for the same
+        group — a net cracked by rule r must not re-report for r+1).
+        """
+        founds = []
+        for ni, net in enumerate(group):
+            if id(net.line) not in live:
+                continue  # already cracked; the step still computes it
+            nf = found[ni]  # [V_max, B]
+            hit_cols = np.flatnonzero(nf.any(axis=0))
+            for b in hit_cols:
+                if psk_by_col is None:
+                    psk = pws[b]
+                    if psk is None:
+                        continue  # zeroed rule column (see _RuleWords)
+                else:
+                    psk = psk_by_col.get(int(b))
+                    if psk is None:
+                        continue  # defensive: every hit col is exchanged
+                delta, endian = (0, None)
+                if net.keyver != 100:
+                    delta, endian = net.variants[int(nf[:, b].argmax())]
+                pmk_bytes = bo.words_to_bytes_be(pmk_col(int(b)))
+                if self.verify_with_oracle:
+                    chk = oracle.check_key_m22000(net.line, [psk], nc=self.nc)
+                    if chk is None:
+                        continue  # device false positive: reject like the server would
+                founds.append(
+                    Found(
+                        line=net.line,
+                        psk=psk,
+                        nc=delta,
+                        endian=endian or "",
+                        pmk=pmk_bytes,
+                    )
+                )
+                live.discard(id(net.line))
+                break  # one PSK per net is enough
+        return founds
+
+    def _decode_rules(self, group, bits_dev, pws, nvalid, live) -> list:
+        """Decode a fused rules chunk's bit-packed found-any mask.
+
+        ``bits_dev``: uint32[R, B/32], bit b of word b>>5 = column b
+        matched SOME net (build_rules_step).  The dense per-net matrix
+        and PMKs never cross the tunnel (~tens of MB per chunk); for
+        each set bit the host re-derives which net, the NC delta/endian
+        and the PMK by running the ORACLE on the decoded candidate —
+        finds are rare and the oracle is the executable spec, so this
+        is both cheap and authoritative (regardless of
+        verify_with_oracle, which exists to double-check *device*
+        claims; here the claim IS the oracle's).
+        """
+        founds = []
+        bits = np.asarray(jax.device_get(bits_dev))  # [R, shards*ceil(b/32)]
+        # Per-shard layout: each device packs its local columns into
+        # ceil(b_local/32) words (32-padded), and the dp out-sharding
+        # concatenates the shards — undo both to recover global columns.
+        n = self.mesh.size
+        b_local = (-(-nvalid // n) * n) // n  # cap/n, as built in crack_rules
+        wpb = bits.shape[1] // n
+        for r in range(bits.shape[0]):
+            if pws[r] is None or not bits[r].any():
+                continue  # chunk-padding rule, or no hits for this rule
+            hit = np.unpackbits(
+                bits[r].reshape(n, wpb).view(np.uint8), axis=1,
+                bitorder="little",
+            )[:, :b_local].reshape(-1)
+            for b in np.flatnonzero(hit[:nvalid]):
+                psk = pws[r][int(b)]
+                if psk is None:
+                    continue  # zeroed column (reject/overflow)
+                for net in group:
+                    if id(net.line) not in live:
+                        continue
+                    chk = oracle.check_key_m22000(net.line, [psk], nc=self.nc)
+                    if chk is None:
+                        continue  # device false positive for this net
+                    _, delta, endian, pmk = chk
+                    founds.append(
+                        Found(line=net.line, psk=psk, nc=delta or 0,
+                              endian=endian or "", pmk=pmk)
+                    )
+                    live.discard(id(net.line))
+        return founds
+
     def _collect(self, dispatched) -> list:
         """Sync stage: gate on hits, decode founds, prune cracked nets."""
         t0 = time.perf_counter()
@@ -714,55 +837,55 @@ class M22000Engine:
         multiproc = jax.process_count() > 1
         founds = []
         live = {id(n.line) for g in self.groups.values() for n in g}
-        for group, (hits, found_dev, pmk_dev) in outs:
+        for group, out in outs:
             # The psum hits-gate: one replicated scalar is the only
             # device->host sync on the (overwhelmingly common) all-miss
             # batch; the [N, V, B] matrix and PMKs stay on device.
-            if int(np.asarray(hits)) == 0:
+            if int(np.asarray(out[0])) == 0:
                 continue
+            if len(out) == 2:  # fused rules chunk: (hits, packed found-any)
+                founds += self._decode_rules(group, out[1], pws, nvalid, live)
+                continue
+            hits, found_dev, pmk_dev = out
             if multiproc:
                 found, pmk_host, psk_by_col = self._gather_find_data(
                     found_dev, pmk_dev, pws, nvalid
                 )
-            else:
-                # One device_get for both arrays: through the tunnel each
-                # D2H fetch costs ~0.13 s fixed, and the find path is part
-                # of every small work unit's constant overhead (the
-                # challenge gate, 1k-word PR-dict units).
+                founds += self._decode(group, found,
+                                       lambda b: pmk_host[:, b], pws,
+                                       psk_by_col, live)
+                continue
+            if pmk_dev.nbytes <= (1 << 21):
+                # Small batch: one merged fetch of both arrays (each D2H
+                # costs ~0.13 s fixed through the tunnel; this path is in
+                # every small work unit's constant overhead).
                 found, pmk_host = jax.device_get((found_dev, pmk_dev))
-                found = np.array(found)  # writable host copy
+                found = np.array(found)
+                pmk_col = lambda b: pmk_host[:, b]
+            else:
+                # Big batch: the dense PMK matrix is MBs (~1 s/4 MB
+                # through the tunnel) while real find batches carry a
+                # handful of hits.  Fetch the bool matrix alone, then
+                # gather ONLY the hit columns' PMKs on device (fixed
+                # 128-slot shape, one extra dispatch on find batches).
+                found = np.array(jax.device_get(found_dev))
                 found[:, :, nvalid:] = False
-                psk_by_col = None
-            for ni, net in enumerate(group):
-                if id(net.line) not in live:
-                    continue  # already cracked; the step still computes it
-                nf = found[ni]  # [V_max, B]
-                hit_cols = np.flatnonzero(nf.any(axis=0))
-                for b in hit_cols:
-                    if psk_by_col is None:
-                        psk = pws[b]
-                    else:
-                        psk = psk_by_col.get(int(b))
-                        if psk is None:
-                            continue  # defensive: every hit col is exchanged
-                    delta, endian = (0, None)
-                    if net.keyver != 100:
-                        delta, endian = net.variants[int(nf[:, b].argmax())]
-                    pmk_bytes = bo.words_to_bytes_be(pmk_host[:, b])
-                    if self.verify_with_oracle:
-                        chk = oracle.check_key_m22000(net.line, [psk], nc=self.nc)
-                        if chk is None:
-                            continue  # device false positive: reject like the server would
-                    founds.append(
-                        Found(
-                            line=net.line,
-                            psk=psk,
-                            nc=delta,
-                            endian=endian or "",
-                            pmk=pmk_bytes,
-                        )
-                    )
-                    break  # one PSK per net is enough
+                cols = np.flatnonzero(found.any(axis=(0, 1)))
+                if len(cols) <= self.MAX_FINDS_PER_BATCH:
+                    gather = getattr(self, "_pmk_gather_jit", None)
+                    if gather is None:
+                        gather = self._pmk_gather_jit = jax.jit(
+                            lambda p, c: p[..., c])
+                    pad = np.zeros(self.MAX_FINDS_PER_BATCH, np.int32)
+                    pad[: len(cols)] = cols
+                    pmk_cols = np.asarray(gather(pmk_dev, pad))
+                    slot = {int(b): i for i, b in enumerate(cols)}
+                    pmk_col = lambda b: pmk_cols[:, slot[b]]
+                else:  # pathological hit density: dense fallback
+                    pmk_host = np.asarray(jax.device_get(pmk_dev))
+                    pmk_col = lambda b: pmk_host[:, b]
+            found[:, :, nvalid:] = False
+            founds += self._decode(group, found, pmk_col, pws, None, live)
         for f in founds:
             self.remove(f)
         self.stage_times["collect"] += time.perf_counter() - t0
@@ -836,6 +959,170 @@ class M22000Engine:
                 batch = []
         if batch:
             submit(batch)
+        pipe.drain()
+        return pipe.founds
+
+    def crack_rules(self, words, rules, on_batch=None) -> list:
+        """Rules attack with ON-DEVICE mangling (rules/device.py).
+
+        The host uploads each base batch ONCE (packed + lengths) and
+        every device-eligible rule mangles it on device — candidate H2D
+        drops by the rule count, which is what lets a rules attack
+        sustain the dict-path rate through the tunnel (hashcat runs its
+        rule engine on the GPU for the same reason; BENCH host_feed
+        shows host expansion can't feed a mesh).  Per base batch:
+
+        - words a rule can't cover on device ($HEX/overlong bases, the
+          rare length-overflow (word, rule) pairs flagged by
+          ``simulate_lens``, rules with unsupported ops) are expanded
+          by the host interpreter and fed through the normal packed
+          path — same pipeline, same stream;
+        - hit columns decode by applying the HOST rule to the base word
+          (``_RuleWords``), so the device transform is never trusted
+          for results; with ``verify_with_oracle`` every find is
+          re-checked against the executable spec.
+
+        ``on_batch(consumed, founds)`` fires per dispatched batch with
+        ``consumed`` = candidates that batch covered (a fused chunk
+        covers base-words x chunk-rules at once).  Stream order is
+        fixed (base-batch major, then device rule chunks in order, then
+        the batch's host-expanded tail), so skip-by-count resume works
+        like ``crack``.  Multi-process meshes fall back to host
+        expansion entirely (the per-column masks here are host-local).
+        """
+        from ..parallel import shard_candidates
+        from ..parallel.mesh import DP_AXIS
+        from ..parallel.step import RULES_CHUNK
+        from ..rules.device import (
+            device_supported, encode_rule, simulate_lens, stack_rules,
+        )
+
+        if jax.process_count() > 1:
+            from ..rules import apply_rules
+
+            return self.crack(apply_rules(rules, words), on_batch=on_batch)
+
+        dev_rules = [(r, encode_rule(r)) for r in rules if device_supported(r)]
+        host_rules = [r for r in rules if not device_supported(r)]
+        pipe = _Pipeline(self, on_batch)
+
+        def flush(batch):
+            from ..native import pack_candidates_fast
+
+            plain, fallback = [], []
+            for w in batch:
+                # Host-fallback words: overlong bases, and anything that
+                # could put "$HEX[...]" syntax in front of the engine's
+                # unhex stage (the host paths unhex AFTER rule
+                # application, so the device must not hash such words
+                # literally).  The substring check also catches bases a
+                # rule could extend into a valid wrapper; synthesizing
+                # "HEX[" itself from unrelated characters via chained
+                # inserts remains a documented, pathological divergence.
+                if len(w) > MAX_PSK_LEN or b"HEX[" in w:
+                    fallback.append((w, None))  # None = every rule
+                else:
+                    plain.append(w)
+            if plain and self.groups and dev_rules:
+                t0 = time.perf_counter()
+                # Pad to the engine batch size like _prepare: a distinct
+                # cap per partial batch would mean a fresh multi-second
+                # XLA compile of the fused step per distinct count.
+                cap = max(self.batch_size,
+                          -(-len(plain) // self.mesh.size) * self.mesh.size)
+                packed = pack_candidates_fast(plain, 0, MAX_PSK_LEN, cap)
+                if packed is None:  # no native lib: plain Python pack
+                    rows = np.zeros((cap, 16), np.uint32)
+                    rows[:len(plain)] = bo.pack_passwords_be(plain)
+                    lens = np.asarray([len(w) for w in plain], np.uint8)
+                else:
+                    rows, lens, n = packed
+                    assert n == len(plain)  # min_len=0: no compaction
+                base_dev = shard_candidates(self.mesh, rows[:cap])
+                lens_pad = np.zeros(cap, np.int32)
+                lens_pad[:len(plain)] = lens
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                lens_dev = jax.device_put(
+                    lens_pad, NamedSharding(self.mesh, P(DP_AXIS)))
+                lens_np = lens_pad[:len(plain)]
+                self.stage_times["prepare"] += time.perf_counter() - t0
+                # Chunked fused dispatch: each chunk of RULES_CHUNK rules
+                # runs expand+PBKDF2+verify in ONE device call per group
+                # with ONE hits-gate (through the tunnel every dispatch
+                # costs ~0.1 s fixed — per-rule dispatch would throttle
+                # the attack; see parallel/step.py build_rules_step).
+                for c0 in range(0, len(dev_rules), RULES_CHUNK):
+                    if not self.groups:
+                        break
+                    chunk = dev_rules[c0:c0 + RULES_CHUNK]
+                    overflow = 0
+                    for rule, _steps in chunk:
+                        _, hostneed = simulate_lens(rule, lens_np)
+                        if hostneed.any():
+                            pairs = [(plain[i], rule)
+                                     for i in np.flatnonzero(hostneed)]
+                            fallback.extend(pairs)
+                            overflow += len(pairs)
+                    stack = stack_rules([s for _, s in chunk], RULES_CHUNK)
+                    pws = [_RuleWords(plain, r) for r, _ in chunk]
+                    pws += [None] * (RULES_CHUNK - len(chunk))
+                    t0 = time.perf_counter()
+                    outs = []
+                    for essid in list(self.groups):
+                        step = self._rules_step_for(essid)
+                        outs.append(
+                            (self._full[essid], step(base_dev, lens_dev, stack))
+                        )
+                    self.stage_times["dispatch"] += time.perf_counter() - t0
+                    # consumed excludes the overflow pairs deferred to the
+                    # host tail — each candidate is counted exactly once,
+                    # or skip-by-count resume would overshoot.
+                    pipe.push((pws, len(plain), outs),
+                              len(plain) * len(chunk) - overflow)
+            # Host-expanded tail: unsupported rules over plain words,
+            # plus the per-(word, rule) fallbacks collected above.
+            # ``consumed`` counts attempted (word, rule) pairs — rejects
+            # included, mirroring how the device chunks count them.
+            out = []
+            pairs_pending = 0
+
+            def submit_host(cands, consumed):
+                prep = self._prepare(cands)
+                if prep is not None and self.groups:
+                    pipe.push(self._dispatch(prep), consumed)
+                else:
+                    pipe.skip(consumed)
+
+            def tail(w, rr):
+                nonlocal out, pairs_pending
+                pairs_pending += 1
+                o = rr.apply(w)
+                if o is not None:
+                    out.append(o)
+                    if len(out) >= self.batch_size:
+                        submit_host(out, pairs_pending)
+                        out, pairs_pending = [], 0
+
+            for w, r in fallback:
+                for rr in (rules if r is None else [r]):
+                    tail(w, rr)
+            for w in plain:
+                for rr in host_rules:
+                    tail(w, rr)
+            if out or pairs_pending:
+                submit_host(out, pairs_pending)
+
+        batch = []
+        for w in words:
+            if not self.groups and not pipe.active:
+                break
+            batch.append(w)
+            if len(batch) == self.batch_size:
+                flush(batch)
+                batch = []
+        if batch and (self.groups or pipe.active):
+            flush(batch)
         pipe.drain()
         return pipe.founds
 

@@ -197,6 +197,118 @@ def _assemble_step(mesh, struct, v_max, inv):
     return _STEP_CACHE[key]
 
 
+#: Rules per fused dispatch (build_rules_step).  Fixed so the step's jit
+#: signature is independent of the ruleset size: a 134-line set runs in
+#: ceil(134/8) dispatches, the last padded with noop rules (<= 1 chunk
+#: of waste per base batch) — vs a multi-second XLA compile per distinct
+#: ruleset size.
+RULES_CHUNK = 8
+
+
+def build_rules_step(mesh, nets, salt1, salt2):
+    """The fused rules crack step: expand + PBKDF2 + verify, one dispatch.
+
+    Returns ``step(base[B,16], lens[B], steps[RULES_CHUNK,S,3]) ->
+    (hits, foundbits[R, B/32])``: each rule of the chunk mangles the
+    base batch ON DEVICE (rules/device.expand_traced) and feeds PBKDF2
+    + every net's verify, with ONE psum'd hit scalar gating the whole
+    chunk.  Fusion is what makes a rules attack sustain the dict rate
+    through the axon tunnel: separate expansion/crack dispatches cost
+    ~0.1 s fixed each, and hashcat's GPU rule engine exists for exactly
+    this reason — mangling must live in the kernel, not on the feed
+    path.
+
+    The find output is a BIT-PACKED any-net-matched mask (uint32, bit b
+    of word b>>5 = column b) rather than the [N, V, B] matrix + PMKs:
+    through the tunnel a chunk's dense matrices are tens of MB (~7 s)
+    while the bitmask is B/8 bytes (~32 KB).  The engine re-derives
+    (net, NC, endian, PMK) for the rare hit columns with the host
+    oracle — the executable spec — so no information is lost.
+
+    Like build_crack_step, nothing group-specific is compiled: salts
+    and rule programs are data; the jit cache keys on (batch, step
+    bucket, net-part signatures) only.
+    """
+    from ..rules.device import _get_branches, expand_traced
+
+    _get_branches()  # op table must exist before any trace
+
+    repl = NamedSharding(mesh, P())
+    s1 = jax.device_put(np.asarray(salt1), repl)
+    s2 = jax.device_put(np.asarray(salt2), repl)
+    use_pallas = all(d.platform == "tpu" for d in mesh.devices.flat)
+
+    parts = []
+    for sig, idxs in _partition(nets).items():
+        kind, static = sig[0], sig[1]
+        _, fields, match = _KINDS[kind]
+        group = [nets[i] for i in idxs]
+        mask = np.zeros(_bucket(len(group)), dtype=bool)
+        mask[: len(group)] = True
+        consts = (mask,) + tuple(
+            _pad_nets([getattr(g, f) for g in group]) for f in fields
+        )
+        consts = tuple(jax.device_put(c, repl) for c in consts)
+        parts.append((kind, static, match, consts))
+
+    key = (mesh, "rules_step", use_pallas,
+           tuple((p[0], p[1]) for p in parts),
+           tuple(tuple(c.shape for c in p[3]) for p in parts))
+    if key not in _STEP_CACHE:
+        # The cached closure must NOT capture ``parts``: its const
+        # arrays are the first-built group's replicated device buffers,
+        # and the cache entry outlives that group (verify_step has the
+        # same contract).  Capture only code + arity metadata; consts
+        # arrive per call via *flat_consts.
+        meta = tuple((p[0], p[1], p[2], 1 + len(_KINDS[p[0]][1]))
+                     for p in parts)
+
+        def local(base, lens, steps, s1, s2, *flat_consts):
+            # reassemble the per-part const tuples from the flat arg list
+            it = iter(flat_consts)
+            pcs = [tuple(next(it) for _ in range(nc)) for *_m, nc in meta]
+
+            def one_rule(_carry, rsteps):
+                pw = expand_traced(base, lens, rsteps)
+                pmk = m._pmk_impl(pw, s1, s2, use_pallas=use_pallas)
+                hits_l = jnp.int32(0)
+                any_l = None
+                for (kind, static, match, _nc), consts in zip(meta, pcs):
+                    mask = consts[0]
+                    fnd = jax.vmap(lambda *cs: match(pmk, static, *cs))(
+                        *consts[1:]
+                    )
+                    fnd = fnd & mask[:, None, None]
+                    hits_l = hits_l + jnp.sum(fnd, dtype=jnp.int32)
+                    a = fnd.any(axis=(0, 1))  # [b]
+                    any_l = a if any_l is None else (any_l | a)
+                pad = (-any_l.shape[0]) % 32  # static: local batch shard
+                if pad:
+                    any_l = jnp.pad(any_l, (0, pad))
+                bits = (
+                    any_l.reshape(-1, 32).astype(jnp.uint32)
+                    << jnp.arange(32, dtype=jnp.uint32)[None, :]
+                ).sum(axis=1, dtype=jnp.uint32)
+                return None, (hits_l, bits)
+
+            _, (h, bits) = jax.lax.scan(one_rule, None, steps)
+            return jax.lax.psum(h.sum(), DP_AXIS), bits
+
+        n_specs = sum(1 + len(_KINDS[p[0]][1]) for p in parts)
+        _STEP_CACHE[key] = _shard(
+            mesh, local,
+            (P(DP_AXIS, None), P(DP_AXIS), P(), P(), P()) + (P(),) * n_specs,
+            (P(), P(None, DP_AXIS)),
+        )
+    fn = _STEP_CACHE[key]
+    flat_consts = tuple(c for p in parts for c in p[3])
+
+    def step(base, lens, steps):
+        return fn(base, lens, steps, s1, s2, *flat_consts)
+
+    return step
+
+
 def build_crack_step(mesh, nets, salt1, salt2):
     """The full crack step for one ESSID group over ``mesh``.
 

@@ -1,0 +1,165 @@
+"""On-device rule mangling vs the host interpreter (the executable spec).
+
+The device path (rules/device.py) must reproduce rules/engine.py
+bit-for-bit for every supported op — same position conventions, same
+out-of-range no-ops, same reject semantics — with rejected/out-of-range
+outputs surfacing as zeroed (None) columns instead of stream compaction.
+"""
+
+import numpy as np
+import pytest
+
+from dwpa_tpu import testing as T
+from dwpa_tpu.models.m22000 import M22000Engine, MAX_PSK_LEN, MIN_PSK_LEN
+from dwpa_tpu.rules import apply_rules, parse_rule, parse_rules
+from dwpa_tpu.rules.device import (
+    W,
+    apply_rule_device,
+    device_supported,
+    encode_rule,
+    simulate_lens,
+    step_bucket,
+)
+
+# Varied shapes: empty, short, exactly-min, mixed case, digits, specials,
+# 4-byte-boundary lengths, near-max, max.
+WORDS = [
+    b"",
+    b"a",
+    b"sevench",
+    b"password",
+    b"Password1",
+    b"PASSWORD!",
+    b"mIxEd CaSe words",
+    b"0123456789abcdef",
+    b"with.dots.and-dashes_",
+    b"x" * 31,
+    b"Y" * 32,
+    b"wrap-around-word-here33",
+    b"a b a b a b",
+    b"zzzz" * 15 + b"zz",  # 62
+    b"q" * 63,
+]
+
+# Every device op family, with in-range and out-of-range positions.
+RULES = [
+    ":", "l", "u", "c", "C", "t", "T0", "T3", "TZ", "r", "d", "f",
+    "{", "}", "[", "]", "D0", "D5", "DZ", "x04", "x2A", "O12", "O9Z",
+    "i3!", "iZ^", "o0#", "o8$", "'5", "'0", "$1", "$ ", "^0", "^~",
+    "sab", "s  ", "saA", "z2", "Z3", "zA", "q", "k", "K", "*05", "*AZ",
+    "L2", "R2", "+0", "-0", ".3", ",3", "y3", "Y3", "yZ", "e-", "E",
+    "p2", "p0",
+    "<5", "<Z", ">5", "_8", "!a", "/a", "(p", ")d", "=0p", "=5s", "%2a",
+    # multi-step compositions, including grow-then-shrink
+    "c $1 $2 $3", "u r ]", "T0 T1 T2 T3", "$1 $2 ] ]", "d '9", "l s0O u",
+    "^a ^b ^c r", "f 'C", "z3 ]", "e- T0", "<Z $!",
+]
+
+
+def _host_expected(rule, word):
+    out = rule.apply(word)
+    if out is None or not MIN_PSK_LEN <= len(out) <= MAX_PSK_LEN:
+        return None
+    return out
+
+
+@pytest.mark.parametrize("rtext", RULES)
+def test_device_matches_host_interpreter(rtext):
+    rule = parse_rule(rtext)
+    assert device_supported(rule)
+    got = apply_rule_device(WORDS, rule)
+    for w, g in zip(WORDS, got):
+        exp = _host_expected(rule, w)
+        # device may defer an overflowing column to the host (None with
+        # hostneed) — apply_rule_device already reports those as None,
+        # and simulate_lens must have flagged them
+        if g is None and exp is not None:
+            _, hostneed = simulate_lens(rule, np.asarray([len(w)]))
+            assert hostneed[0], f"{rtext!r} on {w!r}: expected {exp!r}, got None"
+        else:
+            assert g == exp, f"{rtext!r} on {w!r}: expected {exp!r}, got {g!r}"
+
+
+def test_purge_not_device_supported():
+    assert not device_supported(parse_rule("@a"))
+    assert device_supported(parse_rule("sab $1"))
+
+
+def test_encode_and_bucket():
+    r = parse_rule("c $1 $2")
+    enc = encode_rule(r)
+    assert enc.shape == (3, 3) and enc.dtype == np.int32
+    assert step_bucket(3) == 4 and step_bucket(4) == 4 and step_bucket(5) == 8
+
+
+def test_simulate_lens_flags_overflow():
+    rule = parse_rule("d d")  # 4x growth
+    lens = np.asarray([10, W // 4, W // 2, W])
+    out, hostneed = simulate_lens(rule, lens)
+    assert list(hostneed) == [40 > W, False, True, True]
+    assert out[0] == 40 and out[1] == W
+
+
+def test_crack_rules_equals_host_expansion():
+    """Engine-level: crack_rules finds exactly what host-expanded crack
+    finds, planted PSK reachable only through a device rule."""
+    rules = parse_rules([":", "u", "c $1", "$9 $9", "r"])
+    base = [b"unit%04dword" % i for i in range(300)]
+    # planted: "Unitword0217x" ... use rule "c $1" on base word
+    psk = parse_rule("c $1").apply(b"unit0217word")
+    assert psk == b"Unit0217word1"
+    lines = [T.make_pmkid_line(psk, b"rules-dev-essid", seed="rd")]
+    founds = M22000Engine(lines, batch_size=128).crack_rules(base, rules)
+    assert len(founds) == 1 and founds[0].psk == psk
+    founds2 = M22000Engine(lines, batch_size=128).crack(
+        apply_rules(rules, base))
+    assert len(founds2) == 1 and founds2[0].psk == psk
+
+
+def test_crack_rules_host_fallbacks():
+    """Unsupported ops (@), $HEX bases, and overflow pairs all route to
+    host expansion and still crack."""
+    # 1. '@' rule: only reachable by purging 'x'
+    psk1 = b"abcdefgh1"
+    rules = parse_rules(["@x"])
+    lines = [T.make_pmkid_line(psk1, b"fb-essid-1", seed="f1")]
+    founds = M22000Engine(lines, batch_size=64).crack_rules(
+        [b"xaxbxcxdxexfxgxhx1"], rules)
+    assert [f.psk for f in founds] == [psk1]
+
+    # 2. $HEX base words bypass the device (rule semantics apply to the
+    #    raw text, then the engine unhexes — matching the host path):
+    #    ':' keeps the wrapper intact -> decoded PSK; '$!' breaks the
+    #    wrapper -> literal candidate.  Both must equal host expansion.
+    hexw = b"$HEX[" + b"hexbase9".hex().encode() + b"]"
+    for rtext, psk2 in ((":", b"hexbase9"), ("$!", hexw + b"!")):
+        rules2 = parse_rules([rtext])
+        lines = [T.make_pmkid_line(psk2, b"fb-essid-2" + rtext.encode(),
+                                   seed="f2" + rtext)]
+        founds = M22000Engine(lines, batch_size=64).crack_rules([hexw], rules2)
+        host = M22000Engine(lines, batch_size=64).crack(
+            apply_rules(rules2, [hexw]))
+        assert [f.psk for f in founds] == [psk2]
+        assert [f.psk for f in host] == [psk2]
+
+    # 3. overflow pair: 'd' doubles a 50-char word past W? (needs > W/2)
+    base3 = b"m" * (W // 2 + 1)
+    rule3 = parse_rules(["d 'C"])  # 102 bytes intermediate, truncate to 12
+    psk3 = rule3[0].apply(base3)
+    assert psk3 == b"m" * 12
+    lines = [T.make_pmkid_line(psk3, b"fb-essid-3", seed="f3")]
+    founds = M22000Engine(lines, batch_size=64).crack_rules([base3], rule3)
+    assert [f.psk for f in founds] == [psk3]
+
+
+def test_crack_rules_on_batch_order():
+    """on_batch fires in stream order with consumed counts covering the
+    whole expanded stream (resume contract)."""
+    rules = parse_rules([":", "u"])
+    base = [b"orderw%03d" % i for i in range(100)]
+    lines = [T.make_pmkid_line(b"not-there-1", b"ob-essid", seed="ob")]
+    seen = []
+    M22000Engine(lines, batch_size=64).crack_rules(
+        base, rules, on_batch=lambda n, f: seen.append(n))
+    # 2 base batches (64 + 36), both rules fused into one chunk each
+    assert seen == [64 * 2, 36 * 2]
