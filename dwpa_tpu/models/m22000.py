@@ -784,7 +784,7 @@ class M22000Engine:
                 break  # one PSK per net is enough
         return founds
 
-    def _decode_rules(self, group, bits_dev, pws, nvalid, live) -> list:
+    def _decode_rules(self, group, bits_dev, pws, nvalid, b_local, live) -> list:
         """Decode a fused rules chunk's bit-packed found-any mask.
 
         ``bits_dev``: uint32[R, B/32], bit b of word b>>5 = column b
@@ -796,6 +796,13 @@ class M22000Engine:
         is both cheap and authoritative (regardless of
         verify_with_oracle, which exists to double-check *device*
         claims; here the claim IS the oracle's).
+
+        ``b_local`` is the dispatch's per-shard column count
+        (``cap // mesh.size``), carried through the pipeline record from
+        the ONE place that padded the batch — re-deriving it here from
+        ``nvalid`` once silently sliced off every hit in a partial batch
+        (``nvalid < batch_size`` pads to ``batch_size``, not to
+        ``ceil(nvalid/n)*n``).
         """
         founds = []
         bits = np.asarray(jax.device_get(bits_dev))  # [R, shards*ceil(b/32)]
@@ -803,14 +810,16 @@ class M22000Engine:
         # ceil(b_local/32) words (32-padded), and the dp out-sharding
         # concatenates the shards — undo both to recover global columns.
         n = self.mesh.size
-        b_local = (-(-nvalid // n) * n) // n  # cap/n, as built in crack_rules
+        assert b_local * n >= nvalid, (b_local, n, nvalid)
         wpb = bits.shape[1] // n
         for r in range(bits.shape[0]):
             if pws[r] is None or not bits[r].any():
                 continue  # chunk-padding rule, or no hits for this rule
+            # ascontiguousarray: the axon plugin's device_get can hand
+            # back non-C-contiguous rows, which .view(uint8) rejects.
             hit = np.unpackbits(
-                bits[r].reshape(n, wpb).view(np.uint8), axis=1,
-                bitorder="little",
+                np.ascontiguousarray(bits[r].reshape(n, wpb)).view(np.uint8),
+                axis=1, bitorder="little",
             )[:, :b_local].reshape(-1)
             for b in np.flatnonzero(hit[:nvalid]):
                 psk = pws[r][int(b)]
@@ -833,7 +842,10 @@ class M22000Engine:
     def _collect(self, dispatched) -> list:
         """Sync stage: gate on hits, decode founds, prune cracked nets."""
         t0 = time.perf_counter()
-        pws, nvalid, outs = dispatched
+        pws, nvalid, outs = dispatched[:3]
+        # Rules records carry the dispatch's per-shard width as a 4th
+        # element (see _decode_rules on why it cannot be re-derived).
+        b_shard = dispatched[3] if len(dispatched) > 3 else None
         multiproc = jax.process_count() > 1
         founds = []
         live = {id(n.line) for g in self.groups.values() for n in g}
@@ -844,7 +856,8 @@ class M22000Engine:
             if int(np.asarray(out[0])) == 0:
                 continue
             if len(out) == 2:  # fused rules chunk: (hits, packed found-any)
-                founds += self._decode_rules(group, out[1], pws, nvalid, live)
+                founds += self._decode_rules(group, out[1], pws, nvalid,
+                                             b_shard, live)
                 continue
             hits, found_dev, pmk_dev = out
             if multiproc:
@@ -1078,7 +1091,7 @@ class M22000Engine:
                     # consumed excludes the overflow pairs deferred to the
                     # host tail — each candidate is counted exactly once,
                     # or skip-by-count resume would overshoot.
-                    pipe.push((pws, len(plain), outs),
+                    pipe.push((pws, len(plain), outs, cap // self.mesh.size),
                               len(plain) * len(chunk) - overflow)
             # Host-expanded tail: unsupported rules over plain words,
             # plus the per-(word, rule) fallbacks collected above.

@@ -152,6 +152,73 @@ def test_crack_rules_host_fallbacks():
     assert [f.psk for f in founds] == [psk3]
 
 
+def test_crack_rules_partial_batch_hit():
+    """Regression (VERDICT r4 Weak #1): a hit in a PARTIAL device batch
+    (nvalid < batch_size) must decode.  crack_rules pads the dispatch to
+    cap = max(batch_size, ceil(nvalid/n)*n) but the decode once
+    re-derived the per-shard width from nvalid alone, so hits in partial
+    batches were sliced off or mapped to the wrong base word (then
+    silently dropped by the oracle re-check).  Exact recorded repro:
+    20 words, batch_size=64, rule ':', PSK = word 10, 8-device mesh."""
+    base = [b"partial%03dw" % i for i in range(20)]
+    psk = base[10]
+    lines = [T.make_pmkid_line(psk, b"pb-essid", seed="pb")]
+    founds = M22000Engine(lines, batch_size=64).crack_rules(
+        base, parse_rules([":"]))
+    assert [f.psk for f in founds] == [psk]
+
+
+def test_crack_rules_partial_batch_hit_sliced_column():
+    """Partial batch, hit at a local column >= the buggy per-shard width
+    (ceil(nvalid/n)): with 20 valid words on an 8-way mesh the bad width
+    was 3, so word 12 (shard 1, local col 4) was sliced off entirely."""
+    base = [b"sliced%03dww" % i for i in range(20)]
+    psk = parse_rule("u").apply(base[12])
+    lines = [T.make_pmkid_line(psk, b"pb2-essid", seed="pb2")]
+    founds = M22000Engine(lines, batch_size=64).crack_rules(
+        base, parse_rules(["u"]))
+    assert [f.psk for f in founds] == [psk]
+
+
+def test_crack_rules_partial_final_batch_hit():
+    """(a) multi-batch dict whose FINAL batch is partial and holds the
+    hit — the shape every real dictionary ends with."""
+    base = [b"finalb%04dw" % i for i in range(150)]  # batches: 128 + 22
+    psk = parse_rule("$9").apply(base[141])
+    lines = [T.make_pmkid_line(psk, b"fbp-essid", seed="fbp")]
+    founds = M22000Engine(lines, batch_size=128).crack_rules(
+        base, parse_rules(["$9"]))
+    assert [f.psk for f in founds] == [psk]
+
+
+def test_crack_rules_hex_shrunk_batch_hit():
+    """(b) a full 64-word batch where $HEX bases route to the host
+    fallback, shrinking the device batch's nvalid below batch_size; the
+    hit lives in the shrunken plain set at a column the buggy width
+    (ceil(14/8)=2) would slice (word 13 = shard 1, local col 5)."""
+    hexes = [b"$HEX[" + (b"hx%04d" % i).hex().encode() + b"]"
+             for i in range(50)]
+    plain = [b"plainw%03dq" % i for i in range(14)]
+    base = hexes + plain  # one flush() batch of 64
+    psk = parse_rule("c").apply(plain[13])
+    lines = [T.make_pmkid_line(psk, b"hxs-essid", seed="hxs")]
+    founds = M22000Engine(lines, batch_size=64).crack_rules(
+        base, parse_rules(["c"]))
+    assert [f.psk for f in founds] == [psk]
+
+
+def test_crack_rules_last_occupied_shard_hit():
+    """(c) hit in the LAST shard holding valid words: nvalid=56 on an
+    8-way mesh puts word 55 at shard 6's final local column; the buggy
+    width (ceil(56/8)=7 vs true 8) dropped exactly that column."""
+    base = [b"lastsh%03dww" % i for i in range(56)]
+    psk = base[55]
+    lines = [T.make_pmkid_line(psk, b"lsh-essid", seed="lsh")]
+    founds = M22000Engine(lines, batch_size=64).crack_rules(
+        base, parse_rules([":"]))
+    assert [f.psk for f in founds] == [psk]
+
+
 def test_crack_rules_on_batch_order():
     """on_batch fires in stream order with consumed counts covering the
     whole expanded stream (resume contract)."""
