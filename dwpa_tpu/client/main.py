@@ -27,6 +27,8 @@ import re
 import time
 from dataclasses import dataclass, field
 
+import jax
+
 from ..gen import DictStream, psk_candidates
 from ..models import hashline as hl
 from ..models.m22000 import M22000Engine
@@ -155,16 +157,29 @@ class TpuCrackClient:
         """Compile (or cache-load) the work-sized crack steps behind the
         challenge gate, so the first work unit never stalls on XLA.
 
-        Covers the PBKDF2 shapes real units hit: the configured batch
+        Covers the PBKDF2 shapes real units hit — the configured batch
         size at every trimmed candidate width (W=4 for words <= 16
         chars — nearly every dict — W=8 up to 32, W=16 for the 33-63
-        passphrase tail).  With the persistent cache (see __init__) the
-        compile happens once per installation; afterwards this is
-        ~0.2 s of device work.
+        passphrase tail) — through a MIXED ESSID group (PMKID + one
+        EAPOL per keyver bucket + CMAC), so every verify kind's step and
+        the mixed-group assembly compile here, not on the first real
+        unit.  A unit can still pay a small verify compile for an
+        unusual (V variants, EAPOL blocks) bucket; the dominant PBKDF2
+        trace is shared regardless.  With the persistent cache (see
+        __init__) the compile happens once per installation; afterwards
+        this is ~0.2 s of device work.
         """
         t0 = time.time()
         eng = M22000Engine(
-            [synth.make_pmkid_line(CHALLENGE_PSK, b"dlink", seed="challenge-p")],
+            [
+                synth.make_pmkid_line(CHALLENGE_PSK, b"dlink", seed="challenge-p"),
+                synth.make_eapol_line(CHALLENGE_PSK, b"dlink", keyver=1,
+                                      seed="warm-k1"),
+                synth.make_eapol_line(CHALLENGE_PSK, b"dlink", keyver=2,
+                                      seed="challenge-e"),
+                synth.make_eapol_line(CHALLENGE_PSK, b"dlink", keyver=3,
+                                      seed="warm-k3"),
+            ],
             nc=self.cfg.nc, batch_size=self.cfg.batch_size,
         )
         n = eng.batch_size
@@ -172,6 +187,14 @@ class TpuCrackClient:
         eng.crack_batch([b"warm-long-padding-%08d" % i for i in range(n)])
         eng.crack_batch([b"warm-full-width-passphrase-padding-%08d" % i
                          for i in range(n)])
+        if jax.process_count() == 1:
+            # Pass 2 runs through the fused device-rules step now; warm
+            # both interpreter step buckets so a first unit carrying
+            # server rules doesn't stall on the fused-step compile.
+            from ..rules import parse_rules
+
+            eng.crack_rules([b"warm-%08d" % i for i in range(n)],
+                            parse_rules([":", "c $1 $2"]))
         self.log(f"prewarm: work-size steps ready in {time.time() - t0:.1f}s")
 
     # -- work-unit plumbing ------------------------------------------------
@@ -181,6 +204,14 @@ class TpuCrackClient:
         # batch, and a crash during the write must never corrupt the only
         # copy (a truncated snapshot would be discarded on restart and the
         # whole work unit lost until the server's lease reap).
+        # The version + mesh-topology stamps gate replay: skip-by-count
+        # is only sound against the exact stream order this client build
+        # generates, and both an upgrade and a single-/multi-process
+        # topology change reorder pass 2 (device crack_rules order vs
+        # host apply_rules order) — a mismatched resume could silently
+        # skip candidates that were never tried.
+        work["_ver"] = __version__
+        work["_nproc"] = jax.process_count()
         tmp = self.resume_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(work, f)
@@ -196,7 +227,9 @@ class TpuCrackClient:
         try:
             with open(self.resume_path) as f:
                 work = json.load(f)
-            if "hkey" in work and "hashes" in work and "dicts" in work:
+            if ("hkey" in work and "hashes" in work and "dicts" in work
+                    and work.get("_ver") == __version__
+                    and work.get("_nproc") == jax.process_count()):
                 return work
         except (ValueError, OSError):
             pass
@@ -334,19 +367,19 @@ class TpuCrackClient:
 
     # -- the loop ----------------------------------------------------------
 
-    def _all_candidates(self, engine: M22000Engine, work: dict):
-        """The full deterministic candidate stream for one work unit:
-        pass 1 (targeted generators, then cracked/rkg through rules) and
-        pass 2 (remaining server dicts through server rules).  Dict
-        downloads happen lazily when the stream reaches them, so a
-        resume skipping pass 1 still fetches dicts."""
+    def _pass1_candidates(self, engine: M22000Engine, work: dict, rules):
+        """Pass-1 deterministic host-side stream: targeted generators,
+        then cracked/rkg through the work rules (highest-yield first,
+        help_crack.py:615-687)."""
         yield from self._targeted_candidates(engine, work)
-        rules = self._rules(work)
         yield from self._cracked_candidates(work, rules)
+
+    def _pass2_words(self, work: dict):
+        """Pass-2 BASE words: the remaining server dicts, in work-unit
+        order.  Downloads happen lazily when the stream reaches a dict,
+        so a resume skipping pass 1 still fetches them."""
         for path in self._fetch_dicts(work):
-            stream = DictStream(path)
-            yield from (apply_rules(rules, stream, workers=self.cfg.rule_workers)
-                        if rules else stream)
+            yield from DictStream(path)
 
     def process_work(self, work: dict) -> WorkResult:
         t0 = time.time()
@@ -382,12 +415,34 @@ class TpuCrackClient:
             }
             self._write_resume(work)
 
-        stream = self._all_candidates(engine, work)
+        # Pass 1 materializes host-side, so its resume fast-forward is a
+        # plain islice; whatever the window doesn't cover carries into
+        # pass 2.  Pass-2 rules run ON DEVICE (crack_rules: one base-word
+        # upload mangled by every rule — the hashcat-on-GPU analog of
+        # help_crack.py:773's ``-S -r``), where candidates never exist
+        # host-side; crack_rules' own skip honors the same count contract.
+        rules = self._rules(work)
+        stream1 = iter(self._pass1_candidates(engine, work, rules))
+        skipped = 0
         if skip:
             self.log(f"resuming work unit at candidate {skip}")
-            for _ in itertools.islice(stream, skip):
+            skipped = sum(1 for _ in itertools.islice(stream1, skip))
+        engine.crack(stream1, on_batch=on_batch)
+        skip2 = skip - skipped
+        words = self._pass2_words(work)
+        if rules and jax.process_count() == 1:
+            engine.crack_rules(words, rules, on_batch=on_batch, skip=skip2)
+        elif rules:
+            # Multi-process mesh: host expansion through the worker pool
+            # still outfeeds per-host shards (BENCH host_feed).
+            exp = apply_rules(rules, words, workers=self.cfg.rule_workers)
+            for _ in itertools.islice(exp, skip2):
                 pass
-        engine.crack(stream, on_batch=on_batch)
+            engine.crack(exp, on_batch=on_batch)
+        else:
+            for _ in itertools.islice(words, skip2):
+                pass
+            engine.crack(words, on_batch=on_batch)
         tried = done - skip
 
         elapsed = time.time() - t0

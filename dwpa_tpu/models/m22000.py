@@ -975,7 +975,7 @@ class M22000Engine:
         pipe.drain()
         return pipe.founds
 
-    def crack_rules(self, words, rules, on_batch=None) -> list:
+    def crack_rules(self, words, rules, on_batch=None, skip: int = 0) -> list:
         """Rules attack with ON-DEVICE mangling (rules/device.py).
 
         The host uploads each base batch ONCE (packed + lengths) and
@@ -1002,6 +1002,16 @@ class M22000Engine:
         the batch's host-expanded tail), so skip-by-count resume works
         like ``crack``.  Multi-process meshes fall back to host
         expansion entirely (the per-column masks here are host-local).
+
+        ``skip``: resume fast-forward — the first ``skip`` candidates
+        of the (deterministic) stream are not re-reported.  Sub-batches
+        wholly inside the window are not dispatched at all; a sub-batch
+        straddling the boundary is re-dispatched in full (at-least-once,
+        like ``crack``'s in-flight replay) but reports only its
+        unskipped remainder, so the caller's cumulative count stays
+        exact.  The client's intra-unit resume hangs off this — pass-2
+        candidates never exist host-side, so it cannot islice() them
+        the way pass 1 does (help_crack.py:737-763 restart contract).
         """
         from ..parallel import shard_candidates
         from ..parallel.mesh import DP_AXIS
@@ -1011,13 +1021,28 @@ class M22000Engine:
         )
 
         if jax.process_count() > 1:
+            import itertools
+
             from ..rules import apply_rules
 
-            return self.crack(apply_rules(rules, words), on_batch=on_batch)
+            exp = apply_rules(rules, words)
+            for _ in itertools.islice(exp, skip):
+                pass
+            return self.crack(exp, on_batch=on_batch)
 
         dev_rules = [(r, encode_rule(r)) for r in rules if device_supported(r)]
         host_rules = [r for r in rules if not device_supported(r)]
         pipe = _Pipeline(self, on_batch)
+        skip_left = int(skip)
+
+        def account(consumed: int) -> int:
+            """Consume up to ``consumed`` from the resume window; returns
+            how many candidates this sub-batch must REPORT (0 = wholly
+            inside the completed prefix: don't dispatch)."""
+            nonlocal skip_left
+            take = min(skip_left, consumed)
+            skip_left -= take
+            return consumed - take
 
         def flush(batch):
             from ..native import pack_candidates_fast
@@ -1037,6 +1062,31 @@ class M22000Engine:
                 else:
                     plain.append(w)
             if plain and self.groups and dev_rules:
+                # Per-chunk accounting and host-overflow routing run
+                # BEFORE any device work: a resume window covering the
+                # whole batch must not pay the H2D upload, and the
+                # overflow pairs belong to the host tail regardless.
+                # ``consumed`` excludes the overflow pairs deferred to
+                # the host tail — each candidate is counted exactly
+                # once, or skip-by-count resume would overshoot.
+                lens_np = np.asarray([len(w) for w in plain], np.int32)
+                plan = []  # (chunk, candidates to report; 0 = skip)
+                for c0 in range(0, len(dev_rules), RULES_CHUNK):
+                    chunk = dev_rules[c0:c0 + RULES_CHUNK]
+                    overflow = 0
+                    for rule, _steps in chunk:
+                        _, hostneed = simulate_lens(rule, lens_np)
+                        if hostneed.any():
+                            pairs = [(plain[i], rule)
+                                     for i in np.flatnonzero(hostneed)]
+                            fallback.extend(pairs)
+                            overflow += len(pairs)
+                    plan.append(
+                        (chunk, account(len(plain) * len(chunk) - overflow))
+                    )
+            else:
+                plan = []
+            if any(rep for _, rep in plan):
                 t0 = time.perf_counter()
                 # Pad to the engine batch size like _prepare: a distinct
                 # cap per partial batch would mean a fresh multi-second
@@ -1047,36 +1097,27 @@ class M22000Engine:
                 if packed is None:  # no native lib: plain Python pack
                     rows = np.zeros((cap, 16), np.uint32)
                     rows[:len(plain)] = bo.pack_passwords_be(plain)
-                    lens = np.asarray([len(w) for w in plain], np.uint8)
                 else:
-                    rows, lens, n = packed
+                    rows, _, n = packed  # lens_np above is the one source
                     assert n == len(plain)  # min_len=0: no compaction
                 base_dev = shard_candidates(self.mesh, rows[:cap])
                 lens_pad = np.zeros(cap, np.int32)
-                lens_pad[:len(plain)] = lens
+                lens_pad[:len(plain)] = lens_np
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 lens_dev = jax.device_put(
                     lens_pad, NamedSharding(self.mesh, P(DP_AXIS)))
-                lens_np = lens_pad[:len(plain)]
                 self.stage_times["prepare"] += time.perf_counter() - t0
                 # Chunked fused dispatch: each chunk of RULES_CHUNK rules
                 # runs expand+PBKDF2+verify in ONE device call per group
                 # with ONE hits-gate (through the tunnel every dispatch
                 # costs ~0.1 s fixed — per-rule dispatch would throttle
                 # the attack; see parallel/step.py build_rules_step).
-                for c0 in range(0, len(dev_rules), RULES_CHUNK):
+                for chunk, report in plan:
                     if not self.groups:
                         break
-                    chunk = dev_rules[c0:c0 + RULES_CHUNK]
-                    overflow = 0
-                    for rule, _steps in chunk:
-                        _, hostneed = simulate_lens(rule, lens_np)
-                        if hostneed.any():
-                            pairs = [(plain[i], rule)
-                                     for i in np.flatnonzero(hostneed)]
-                            fallback.extend(pairs)
-                            overflow += len(pairs)
+                    if report == 0:
+                        continue  # chunk wholly inside the resume prefix
                     stack = stack_rules([s for _, s in chunk], RULES_CHUNK)
                     pws = [_RuleWords(plain, r) for r, _ in chunk]
                     pws += [None] * (RULES_CHUNK - len(chunk))
@@ -1088,11 +1129,8 @@ class M22000Engine:
                             (self._full[essid], step(base_dev, lens_dev, stack))
                         )
                     self.stage_times["dispatch"] += time.perf_counter() - t0
-                    # consumed excludes the overflow pairs deferred to the
-                    # host tail — each candidate is counted exactly once,
-                    # or skip-by-count resume would overshoot.
                     pipe.push((pws, len(plain), outs, cap // self.mesh.size),
-                              len(plain) * len(chunk) - overflow)
+                              report)
             # Host-expanded tail: unsupported rules over plain words,
             # plus the per-(word, rule) fallbacks collected above.
             # ``consumed`` counts attempted (word, rule) pairs — rejects
@@ -1101,11 +1139,14 @@ class M22000Engine:
             pairs_pending = 0
 
             def submit_host(cands, consumed):
+                report = account(consumed)
+                if report == 0:
+                    return  # batch wholly inside the resume prefix
                 prep = self._prepare(cands)
                 if prep is not None and self.groups:
-                    pipe.push(self._dispatch(prep), consumed)
+                    pipe.push(self._dispatch(prep), report)
                 else:
-                    pipe.skip(consumed)
+                    pipe.skip(report)
 
             def tail(w, rr):
                 nonlocal out, pairs_pending

@@ -335,6 +335,84 @@ def test_archive_logs_appended(server, tmp_path):
     assert json.loads(res_lines.splitlines()[-1])["hkey"]
 
 
+def test_rules_unit_runs_on_device_path(server, tmp_path, monkeypatch):
+    """Pass 2 of a rules work unit goes through engine.crack_rules (the
+    on-device rule engine — the hashcat-on-GPU analog of the reference
+    client's ``-S -r`` invocation, help_crack.py:773), NOT host
+    expansion: apply_rules must never see the pass-2 dict stream."""
+    import dwpa_tpu.client.main as cm
+    from dwpa_tpu.models.m22000 import M22000Engine as Eng
+    from dwpa_tpu.rules import wpa_rules_text
+
+    mangled = b"Devword77!1"  # 'devword77!' through 'c $1'
+    _ingest(server, [tfx.make_pmkid_line(mangled, ESSID, seed="dv1")])
+    os.makedirs(server.dictdir, exist_ok=True)
+    blob = gzip.compress(b"devword77!\n")
+    path = os.path.join(server.dictdir, "dv.txt.gz")
+    open(path, "wb").write(blob)
+    server.add_dict("dict/dv.txt.gz", "dv.txt.gz",
+                    hashlib.md5(blob).hexdigest(), 1, rules=wpa_rules_text())
+
+    calls = []
+    real = Eng.crack_rules
+    monkeypatch.setattr(
+        Eng, "crack_rules",
+        lambda self, *a, **k: (calls.append(k.get("skip", 0)),
+                               real(self, *a, **k))[1])
+    monkeypatch.setattr(
+        cm, "apply_rules",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("pass 2 must not host-expand rules")))
+
+    client = _client(server, tmp_path)
+    work = client.api.get_work(1)
+    res = client.process_work(work)
+    assert calls == [0]  # device path used, fresh unit -> no skip
+    assert [f.psk for f in res.founds] == [mangled]
+    assert server.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+
+def test_rules_unit_resume_mid_pass2(server, tmp_path):
+    """A crash mid-pass-2 of a rules unit resumes through crack_rules'
+    skip: the completed prefix is not re-reported, the PSK (reachable
+    only via a device rule late in pass 2) still cracks, and the unit
+    submits."""
+    from dwpa_tpu.rules import parse_rule
+
+    base = [b"resume%04dw" % i for i in range(90)]
+    psk = parse_rule("u").apply(base[85])
+    _ingest(server, [tfx.make_pmkid_line(psk, ESSID, seed="rm1")])
+    os.makedirs(server.dictdir, exist_ok=True)
+    blob = gzip.compress(b"\n".join(base) + b"\n")
+    path = os.path.join(server.dictdir, "rm.txt.gz")
+    open(path, "wb").write(blob)
+    server.add_dict("dict/rm.txt.gz", "rm.txt.gz",
+                    hashlib.md5(blob).hexdigest(), len(base),
+                    rules="u\n$Z")
+
+    first = _client(server, tmp_path, batch_size=32)
+    work = first.api.get_work(1)
+    res1 = first.process_work(dict(work))
+    assert [f.psk for f in res1.founds] == [psk]
+    total = res1.candidates_tried
+
+    # Replay the unit as a crash at ~40% of the stream: pass 1 is empty
+    # (no targeted hit material beyond generators), so most of the skip
+    # lands inside crack_rules.
+    server.db.x("UPDATE nets SET n_state = 0, pass = NULL, algo = ''")
+    skip = int(total * 0.4)
+    resumed = _client(server, tmp_path / "second", batch_size=32)
+    work2 = dict(work)
+    work2["_progress"] = {"done": skip, "cand": []}
+    res2 = resumed.process_work(work2)
+    assert [f.psk for f in res2.founds] == [psk]
+    assert res2.accepted
+    # reported remainder never exceeds the unskipped tail (at-least-once
+    # may re-TRY a straddling sub-batch, but never re-COUNT it)
+    assert 0 < res2.candidates_tried <= total - skip
+    assert server.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+
 def test_bundled_wpa_rules_crack_mangled_psk(server, tmp_path):
     """A dict packed with the bundled WPA ruleset cracks a PSK that is a
     base word through a rule ('c $1'), end-to-end over the wire — the

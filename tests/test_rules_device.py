@@ -219,6 +219,49 @@ def test_crack_rules_last_occupied_shard_hit():
     assert [f.psk for f in founds] == [psk]
 
 
+def test_crack_rules_skip_resume_contract():
+    """skip=N fast-forwards the deterministic stream by exactly N
+    candidates: wholly-covered sub-batches are not dispatched, a
+    straddling sub-batch re-dispatches in full but reports only its
+    remainder (at-least-once), and a find past the window still decodes.
+    Covers device chunks AND the host-expanded tail ('@' rule)."""
+    rules = parse_rules([":", "u", "c $1", "r", "@a"])  # 4 device + 1 host
+    base = [b"skipw%04d" % i for i in range(150)]  # base batches: 128 + 22
+    psk = parse_rule("c $1").apply(base[140])  # find lives in batch 2's chunk
+    lines = [T.make_pmkid_line(psk, b"skip-essid", seed="sk")]
+
+    def run(skip):
+        seen = []
+        founds = M22000Engine(lines, batch_size=128).crack_rules(
+            base, rules, on_batch=lambda n, f: seen.append(n), skip=skip)
+        return seen, founds
+
+    # Full stream: batch1 chunk (128*4), batch1 tail (128), batch2 chunk
+    # (22*4), batch2 tail (22) = 750 candidates.
+    seen0, founds0 = run(0)
+    assert seen0 == [512, 128, 88, 22]
+    assert [f.psk for f in founds0] == [psk]
+    total = sum(seen0)
+
+    # Window ends exactly at a sub-batch boundary: batch1 chunk dropped.
+    seen1, founds1 = run(512)
+    assert seen1 == [128, 88, 22] and [f.psk for f in founds1] == [psk]
+    # Window straddles the host tail: re-dispatched, remainder reported.
+    seen2, founds2 = run(512 + 60)
+    assert seen2 == [68, 88, 22] and [f.psk for f in founds2] == [psk]
+    # Window covers everything: nothing dispatched, nothing found.
+    seen3, founds3 = run(total)
+    assert seen3 == [] and founds3 == []
+    # Window straddles the find's own chunk: at-least-once replays it and
+    # the find is still reported alongside the remainder count.
+    seen4, founds4 = run(512 + 128 + 10)
+    assert seen4 == [78, 22] and [f.psk for f in founds4] == [psk]
+    # Invariant: reported + skipped == total, for every window.
+    for skip, seen in ((512, seen1), (572, seen2), (total, seen3),
+                       (650, seen4)):
+        assert sum(seen) == total - skip
+
+
 def test_crack_rules_on_batch_order():
     """on_batch fires in stream order with consumed counts covering the
     whole expanded stream (resume contract)."""
