@@ -67,8 +67,9 @@ class ClientConfig:
     cracked_refresh: int = 100      # re-download cracked/rkg dicts every
                                     # N work units (DAW dl_count cadence,
                                     # help_crack.py:47,524-529)
-    rule_workers: int = 0           # >1: expand rules in a process pool
-                                    # (feeds a multi-chip mesh; 0 = inline)
+    rule_workers: int = 0           # >1: expand PASS-1 rules (cracked/rkg
+                                    # dicts) in a process pool; pass 2
+                                    # mangles on device (0 = inline)
     archive: bool = True            # append-only archive.22000/archive.res
                                     # audit logs (DAW, help_crack.py:453-456)
 
@@ -393,6 +394,15 @@ class TpuCrackClient:
         self._write_resume(work)
         progress = work.pop("_progress", None) or {}
         skip = int(progress.get("done", 0))
+        if jax.process_count() > 1:
+            # Hosts may have checkpointed different done counts before a
+            # crash; the pass-2 device path requires an identical skip
+            # everywhere (SPMD lockstep), so all hosts adopt process 0's
+            # (at-least-once: a lower value only re-tries candidates).
+            import numpy as _np
+            from jax.experimental import multihost_utils
+
+            skip = int(multihost_utils.broadcast_one_to_all(_np.int64(skip)))
         self._resuming = skip > 0
         if not self._resuming:
             # once per unit: a resume replay must not duplicate the entry
@@ -430,15 +440,13 @@ class TpuCrackClient:
         engine.crack(stream1, on_batch=on_batch)
         skip2 = skip - skipped
         words = self._pass2_words(work)
-        if rules and jax.process_count() == 1:
+        if rules:
+            # Single- AND multi-process: crack_rules takes the full
+            # global dict stream (every host downloads whole dicts
+            # anyway) and shards internally — each host uploads only its
+            # 1/nproc row slice and decodes finds from the replicated
+            # bitmask, so no host ever feeds expanded candidates.
             engine.crack_rules(words, rules, on_batch=on_batch, skip=skip2)
-        elif rules:
-            # Multi-process mesh: host expansion through the worker pool
-            # still outfeeds per-host shards (BENCH host_feed).
-            exp = apply_rules(rules, words, workers=self.cfg.rule_workers)
-            for _ in itertools.islice(exp, skip2):
-                pass
-            engine.crack(exp, on_batch=on_batch)
         else:
             for _ in itertools.islice(words, skip2):
                 pass

@@ -330,16 +330,25 @@ _ALICE_CHARSET = "0123456789abcdefghijklmnopqrstuvwxyz"
 ALICE_CONFIGS = {}
 
 
-def alice_agpf_key(serial: str, mac: bytes) -> bytes:
+def alice_agpf_key(serial: str, mac: bytes, magic: bytes = None,
+                   charset: str = None, take: int = 24) -> bytes:
     """The core AGPF derivation for one (serial, MAC) pair.
 
     ``serial``: the full manufacturing serial, e.g. ``69102X0013305``.
+    ``magic``/``charset``/``take`` default to the published Alice-Italy
+    constants; the AGPF siblings that reuse this structure with other
+    vendor seeds supply theirs via a deployment pack
+    (gen/vendor_data.py ``serial_hash`` entries).
     """
-    d = hashlib.sha256(_ALICE_MAGIC + serial.encode() + mac).digest()
-    return "".join(_ALICE_CHARSET[b % 36] for b in d[:24]).encode()
+    magic = _ALICE_MAGIC if magic is None else magic
+    charset = _ALICE_CHARSET if charset is None else charset
+    d = hashlib.sha256(magic + serial.encode() + mac).digest()
+    return "".join(charset[b % len(charset)] for b in d[:take]).encode()
 
 
-def alice_agpf_keys(ssid_digits: str, bssid: bytes, configs=None):
+def alice_agpf_keys(ssid_digits: str, bssid: bytes, configs=None,
+                    magic: bytes = None, charset: str = None,
+                    take: int = 24):
     """Candidates for an Alice-XXXXXXXX SSID given serial-mapping config.
 
     Each config entry maps the SSID number S to a serial via
@@ -358,7 +367,8 @@ def alice_agpf_keys(ssid_digits: str, bssid: bytes, configs=None):
         base = int.from_bytes(bssid, "big")
         for off in (0, 1, -1):
             mac = ((base + off) & 0xFFFFFFFFFFFF).to_bytes(6, "big")
-            yield alice_agpf_key(serial, mac)
+            yield alice_agpf_key(serial, mac, magic=magic,
+                                 charset=charset, take=take)
 
 
 # ---------------------------------------------------------------------------

@@ -558,7 +558,10 @@ class M22000Engine:
         t0 = time.perf_counter()
         plist = passwords if isinstance(passwords, list) else list(passwords)
         if not plist:
-            return None
+            # Multi-process: an empty local block must still dispatch
+            # padding or the peers' shard_map collectives hang (see
+            # _padding_prep; returns None single-process).
+            return self._padding_prep(t0)
         # Pad to batch_size (or, for an oversize caller-supplied batch, up
         # to the next mesh-size multiple so the shard_map split stays even).
         cap = max(self.batch_size,
@@ -648,6 +651,16 @@ class M22000Engine:
     #: (a fixed-size allgather keeps the exchange shape static; real
     #: crack batches see hits at ~1e-6 rates, so 128 is generous).
     MAX_FINDS_PER_BATCH = 128
+
+    #: Merge the hits-gate and find-decode fetches into ONE device_get
+    #: when a batch's whole output payload fits under this byte count.
+    #: Through the axon tunnel every D2H call costs ~0.12 s latency
+    #: regardless of payload up to ~512 KB (measured: 64 KB -> 112 ms,
+    #: 512 KB -> 137 ms, 1 MB -> 261 ms), so for small batches the
+    #: gate + decode pair was two round trips where one suffices — this
+    #: halves the small-work-unit fixed constant (bench unit_overhead).
+    #: Big batches keep the scalar gate: their dense matrices are MBs.
+    SMALL_FETCH_BYTES = 600_000
 
     def _replicated(self, x):
         """Reshard a batch-sharded step output to fully replicated.
@@ -805,10 +818,20 @@ class M22000Engine:
         ``ceil(nvalid/n)*n``).
         """
         founds = []
-        bits = np.asarray(jax.device_get(bits_dev))  # [R, shards*ceil(b/32)]
-        # Per-shard layout: each device packs its local columns into
-        # ceil(b_local/32) words (32-padded), and the dp out-sharding
-        # concatenates the shards — undo both to recover global columns.
+        if jax.process_count() > 1:
+            # Partly non-addressable on a multi-process mesh: the jitted
+            # replicate (an all_gather every host enters in lockstep —
+            # the hits-gate already agreed this batch has a find) hands
+            # every host the identical global mask, and the global plain
+            # list (see crack_rules' multi-process contract) lets each
+            # decode every column locally — no candidate exchange.
+            bits = np.asarray(self._replicated(bits_dev))
+        else:
+            bits = np.asarray(jax.device_get(bits_dev))
+        # bits: [R, shards*ceil(b_local/32)].  Per-shard layout: each
+        # device packs its local columns into ceil(b_local/32) words
+        # (32-padded), and the dp out-sharding concatenates the shards —
+        # undo both to recover global columns.
         n = self.mesh.size
         assert b_local * n >= nvalid, (b_local, n, nvalid)
         wpb = bits.shape[1] // n
@@ -849,7 +872,18 @@ class M22000Engine:
         multiproc = jax.process_count() > 1
         founds = []
         live = {id(n.line) for g in self.groups.values() for n in g}
-        for group, out in outs:
+        fetched = None
+        if not multiproc and outs:
+            payload = sum(int(a.nbytes) for _, out in outs for a in out[1:])
+            if payload <= self.SMALL_FETCH_BYTES:
+                # Small batch: ONE merged round trip for every group's
+                # (hits, find data) — see SMALL_FETCH_BYTES.  The
+                # downstream branches are payload-agnostic (device_get
+                # on a host array is a no-op).
+                fetched = jax.device_get([out for _, out in outs])
+        for i, (group, out) in enumerate(outs):
+            if fetched is not None:
+                out = fetched[i]
             # The psum hits-gate: one replicated scalar is the only
             # device->host sync on the (overwhelmingly common) all-miss
             # batch; the [N, V, B] matrix and PMKs stay on device.
@@ -1000,8 +1034,19 @@ class M22000Engine:
         covers base-words x chunk-rules at once).  Stream order is
         fixed (base-batch major, then device rule chunks in order, then
         the batch's host-expanded tail), so skip-by-count resume works
-        like ``crack``.  Multi-process meshes fall back to host
-        expansion entirely (the per-column masks here are host-local).
+        like ``crack``.
+
+        Multi-process contract — UNLIKE ``crack``'s local-shard feed:
+        every host passes the SAME global word stream and the same
+        ``skip`` (hosts hold full dict copies anyway — the reference's
+        volunteers each download whole dictionaries, get_work.php).
+        Each host then packs the global batch but uploads only its
+        1/nproc row slice, and the find decode replicates the bit-packed
+        mask so every host re-derives identical founds from the global
+        column index — the mask path's global-indexing trick
+        (``_LazyWords``), with no candidate exchange.  Host-expanded
+        tails slice the identical global tail per host, so dispatch
+        counts stay in SPMD lockstep with zero extra collectives.
 
         ``skip``: resume fast-forward — the first ``skip`` candidates
         of the (deterministic) stream are not re-reported.  Sub-batches
@@ -1014,21 +1059,16 @@ class M22000Engine:
         the way pass 1 does (help_crack.py:737-763 restart contract).
         """
         from ..parallel import shard_candidates
-        from ..parallel.mesh import DP_AXIS
+        from ..parallel.mesh import shard_vector
         from ..parallel.step import RULES_CHUNK
         from ..rules.device import (
             device_supported, encode_rule, simulate_lens, stack_rules,
         )
 
-        if jax.process_count() > 1:
-            import itertools
-
-            from ..rules import apply_rules
-
-            exp = apply_rules(rules, words)
-            for _ in itertools.islice(exp, skip):
-                pass
-            return self.crack(exp, on_batch=on_batch)
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        #: global words per flush: each host uploads a batch_size slice
+        gbatch = self.batch_size * nproc
 
         dev_rules = [(r, encode_rule(r)) for r in rules if device_supported(r)]
         host_rules = [r for r in rules if not device_supported(r)]
@@ -1091,7 +1131,7 @@ class M22000Engine:
                 # Pad to the engine batch size like _prepare: a distinct
                 # cap per partial batch would mean a fresh multi-second
                 # XLA compile of the fused step per distinct count.
-                cap = max(self.batch_size,
+                cap = max(gbatch,
                           -(-len(plain) // self.mesh.size) * self.mesh.size)
                 packed = pack_candidates_fast(plain, 0, MAX_PSK_LEN, cap)
                 if packed is None:  # no native lib: plain Python pack
@@ -1100,13 +1140,14 @@ class M22000Engine:
                 else:
                     rows, _, n = packed  # lens_np above is the one source
                     assert n == len(plain)  # min_len=0: no compaction
-                base_dev = shard_candidates(self.mesh, rows[:cap])
                 lens_pad = np.zeros(cap, np.int32)
                 lens_pad[:len(plain)] = lens_np
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                lens_dev = jax.device_put(
-                    lens_pad, NamedSharding(self.mesh, P(DP_AXIS)))
+                # Every host packed the identical global batch; ship only
+                # this host's row slice (shard_* assemble the global
+                # array from per-process slices on a multi-process mesh).
+                lo, hi = pid * (cap // nproc), (pid + 1) * (cap // nproc)
+                base_dev = shard_candidates(self.mesh, rows[lo:hi])
+                lens_dev = shard_vector(self.mesh, lens_pad[lo:hi])
                 self.stage_times["prepare"] += time.perf_counter() - t0
                 # Chunked fused dispatch: each chunk of RULES_CHUNK rules
                 # runs expand+PBKDF2+verify in ONE device call per group
@@ -1142,6 +1183,13 @@ class M22000Engine:
                 report = account(consumed)
                 if report == 0:
                     return  # batch wholly inside the resume prefix
+                if nproc > 1:
+                    # The tail stream is the identical global expansion
+                    # on every host; each host dispatches its contiguous
+                    # 1/nproc block (an empty block still dispatches
+                    # padding via _prepare, keeping SPMD lockstep).
+                    blk = -(-len(cands) // nproc)
+                    cands = cands[pid * blk:(pid + 1) * blk]
                 prep = self._prepare(cands)
                 if prep is not None and self.groups:
                     pipe.push(self._dispatch(prep), report)
@@ -1154,7 +1202,7 @@ class M22000Engine:
                 o = rr.apply(w)
                 if o is not None:
                     out.append(o)
-                    if len(out) >= self.batch_size:
+                    if len(out) >= gbatch:
                         submit_host(out, pairs_pending)
                         out, pairs_pending = [], 0
 
@@ -1172,7 +1220,12 @@ class M22000Engine:
             if not self.groups and not pipe.active:
                 break
             batch.append(w)
-            if len(batch) == self.batch_size:
+            # Flush at the GLOBAL batch size: each flush pads the packed
+            # rows to gbatch and every host uploads a 1/nproc slice, so
+            # slicing the stream at batch_size would leave every host
+            # beyond the first shipping pure zero padding (N-host rules
+            # attacks at 1-host throughput).
+            if len(batch) == gbatch:
                 flush(batch)
                 batch = []
         if batch and (self.groups or pipe.active):

@@ -28,20 +28,32 @@ def default_mesh(devices=None, n: int = None) -> Mesh:
     return Mesh(np.asarray(devices), (DP_AXIS,))
 
 
-def shard_candidates(mesh: Mesh, pw_words):
-    """Place a packed [B, 16] candidate batch with B split over the mesh.
+def _shard_batch_axis(mesh: Mesh, x, spec: P):
+    """Place ``x`` with its leading axis split over the dp mesh axis.
 
-    Single-process: ``pw_words`` is the whole batch, placed under the dp
-    sharding.  Multi-process (a ``multihost_mesh`` spanning hosts):
-    ``pw_words`` is this host's *local* shard, assembled into the global
-    array with ``jax.make_array_from_process_local_data`` — device_put
-    cannot express "local slice of a global array" across non-addressable
+    Single-process: ``x`` is the whole batch, placed under the sharding.
+    Multi-process (a ``multihost_mesh`` spanning hosts): ``x`` is this
+    host's *local* shard, assembled into the global array with
+    ``jax.make_array_from_process_local_data`` — device_put cannot
+    express "local slice of a global array" across non-addressable
     devices.
     """
-    sharding = NamedSharding(mesh, P(DP_AXIS, None))
+    sharding = NamedSharding(mesh, spec)
     if jax.process_count() > 1:
-        return jax.make_array_from_process_local_data(sharding, np.asarray(pw_words))
-    return jax.device_put(pw_words, sharding)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+    return jax.device_put(x, sharding)
+
+
+def shard_candidates(mesh: Mesh, pw_words):
+    """Place a packed [B, 16] candidate batch with B split over the mesh
+    (see ``_shard_batch_axis`` for the single-/multi-process contract)."""
+    return _shard_batch_axis(mesh, pw_words, P(DP_AXIS, None))
+
+
+def shard_vector(mesh: Mesh, v):
+    """The [B]-shaped companion of ``shard_candidates`` (e.g. word
+    lengths), same contract."""
+    return _shard_batch_axis(mesh, v, P(DP_AXIS))
 
 
 def multihost_mesh(coordinator: str = None, num_processes: int = None,

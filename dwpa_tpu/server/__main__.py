@@ -184,6 +184,19 @@ def _job_lookups(args):
     return geo, psk
 
 
+def _keygen_gens(args):
+    """``extra_generators`` for keygen precompute: the built-in vendor
+    families, plus any deployment data pack (``--vendor-data``).  None
+    keeps keygen_precompute's default (built-ins only)."""
+    path = getattr(args, "vendor_data", None)
+    if not path:
+        return None
+    from ..gen.vendor_data import load_vendor_pack
+    from ..gen.vendors import vendor_candidates
+
+    return [vendor_candidates] + load_vendor_pack(path)
+
+
 def cmd_jobs(args):
     """The cron layer: one shot of maintenance + keygen (+ geolocation /
     PSK lookup when a source is configured) by default, or continuous
@@ -195,7 +208,8 @@ def cmd_jobs(args):
     geo, psk = _job_lookups(args)
     if not args.loop:
         out = {"maintenance": maintenance(core),
-               "keygen": keygen_precompute(core)}
+               "keygen": keygen_precompute(
+                   core, extra_generators=_keygen_gens(args))}
         if geo:
             out["geolocate"] = geolocate(core, geo)
         if psk:
@@ -215,6 +229,7 @@ def _jobs_loop(core, args, geo, psk):
 
     from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
 
+    gens = _keygen_gens(args)
     last_maint = last_enrich = 0.0
     while True:
         now = time.time()
@@ -228,7 +243,7 @@ def _jobs_loop(core, args, geo, psk):
                 if psk:
                     psk_lookup(core, psk)
                 last_enrich = now
-            keygen_precompute(core)
+            keygen_precompute(core, extra_generators=gens)
         except Exception:
             print("jobs tick failed (will retry):", file=sys.stderr)
             traceback.print_exc()
@@ -344,6 +359,10 @@ def main(argv=None):
                                             "lookups, 3wifi.php)")
         sp.add_argument("--wifi3-url", help="override the 3wifi endpoint "
                                             "(stub testing)")
+        sp.add_argument("--vendor-data",
+                        help="JSON vendor keygen pack (gen/vendor_data.py "
+                             "format): adds data-driven routerkeygen "
+                             "families to keygen precompute")
 
     sp = sub.add_parser("serve", help="run the HTTP API + UI")
     common(sp)

@@ -23,6 +23,13 @@ def main():
 
     import numpy as np
 
+    # Share the suite's persistent XLA cache: the shard_map step HLO is
+    # identical run-to-run and dominates this worker's wall clock.
+    from dwpa_tpu.utils.compcache import enable_compilation_cache
+
+    enable_compilation_cache(os.path.join(
+        os.path.dirname(__file__), "..", ".pytest_xla_cache"))
+
     from dwpa_tpu import testing as tfx
     from dwpa_tpu.models import hashline as hl
     from dwpa_tpu.models import m22000 as m
@@ -113,6 +120,80 @@ def main():
     finds4 = eng4.crack_batch(local4)
     got4 = finds4[0].psk.decode() if finds4 else "NONE"
     print(f"PAD {pid} finds={len(finds4)} psk={got4}", flush=True)
+
+    # Device-rules path across processes (crack_rules' multi-process
+    # contract): every host feeds the SAME global base stream; each
+    # uploads only its row slice and decodes finds from the replicated
+    # bit-packed mask — one PSK reachable only via a device rule ('u')
+    # planted in process 1's row block, and one reachable only via a
+    # host-expanded rule ('@b') planted in process 0's tail block (so
+    # its find must cross hosts through the candidate exchange).
+    from dwpa_tpu.rules import parse_rule, parse_rules
+
+    gsize = 2 * mesh.size  # one global flush: batch_size rows per host
+    base5 = [b"rulebase%02dx" % i for i in range(gsize)]
+    psk_dev = parse_rule("u").apply(base5[mesh.size + 3])   # process 1 rows
+    psk_tail = parse_rule("@b").apply(base5[2])             # process 0 block
+    eng5 = m.M22000Engine(
+        [tfx.make_pmkid_line(psk_dev, b"RuleNetDev", seed="mh-rdev"),
+         tfx.make_pmkid_line(psk_tail, b"RuleNetTail", seed="mh-rtail")],
+        mesh=mesh, batch_size=mesh.size,
+    )
+    finds5 = eng5.crack_rules(base5, parse_rules([":", "u", "@b"]))
+    got5 = ",".join(sorted(f.psk.decode() for f in finds5))
+    print(f"RULES {pid} finds={got5}", flush=True)
+
+    # Mixed-kind ESSID group over the mesh: every verify kind — PMKID,
+    # EAPOL keyver 1 (MD5 MIC), keyver 2 (SHA1 MIC), keyver 3 (AES-CMAC)
+    # — assembled through _assemble_step, with the PSK in process 1's
+    # shard so every kind's find rides the cross-host decode.
+    psk6, essid6 = b"mixedkinds6", b"MixNet"
+    lines6 = [
+        tfx.make_eapol_line(psk6, essid6, keyver=2, seed="mh-k2"),
+        tfx.make_pmkid_line(psk6, essid6, seed="mh-pmk"),
+        tfx.make_eapol_line(psk6, essid6, keyver=1, seed="mh-k1"),
+        tfx.make_eapol_line(psk6, essid6, keyver=3, seed="mh-k3"),
+    ]
+    eng6 = m.M22000Engine(lines6, mesh=mesh, batch_size=mesh.size)
+    words6 = [b"mx-word%04d" % i for i in range(batch2)]
+    words6[batch2 // 2 + 2] = psk6  # process 1's half
+    local6 = words6[pid * (batch2 // 2):(pid + 1) * (batch2 // 2)]
+    finds6 = eng6.crack_batch(local6)
+    kinds6 = ",".join(str(k) for k in sorted(f.line.keyver for f in finds6))
+    print(f"MIXED {pid} finds={len(finds6)} keyvers={kinds6}", flush=True)
+
+    # Dense-find batch: more owned hit columns than MAX_FINDS_PER_BATCH
+    # forces MULTIPLE fixed-shape allgather exchange rounds (the cap is
+    # shrunk instance-side so the path triggers at test scale).  Expect
+    # 1 nvalids-allgather + ceil(6/4)=2 exchange rounds = 3 calls.
+    from jax.experimental import multihost_utils as mhu
+
+    eng7 = m.M22000Engine(
+        [tfx.make_pmkid_line(b"densepsk77", b"DenseNet", seed="mh-dense")],
+        mesh=mesh, batch_size=mesh.size,
+    )
+    eng7.MAX_FINDS_PER_BATCH = 4
+    words7 = [b"dn-word%04d" % i for i in range(batch2)]
+    for k in range(6):  # six hit columns, all inside process 1's half
+        words7[batch2 // 2 + 2 + k] = b"densepsk77"
+    local7 = words7[pid * (batch2 // 2):(pid + 1) * (batch2 // 2)]
+    calls = {"ex": 0}
+    orig_ag = mhu.process_allgather
+
+    def counting_ag(x, *a, **k):
+        # exchange rounds are the fixed-shape uint8 [cap, 6+63] payloads
+        # (jax internals also route through process_allgather, so count
+        # only the candidate-exchange shape)
+        if getattr(x, "ndim", None) == 2 and x.shape[0] == 4:
+            calls["ex"] += 1
+        return orig_ag(x, *a, **k)
+
+    mhu.process_allgather = counting_ag
+    finds7 = eng7.crack_batch(local7)
+    mhu.process_allgather = orig_ag
+    got7 = finds7[0].psk.decode() if finds7 else "NONE"
+    print(f"DENSE {pid} finds={len(finds7)} psk={got7} "
+          f"rounds={calls['ex']}", flush=True)
     jax.distributed.shutdown()
 
 

@@ -33,7 +33,7 @@ def test_two_process_mesh_crack_step():
         )
         for pid in (0, 1)
     ]
-    outs = [p.communicate(timeout=240) for p in procs]
+    outs = [p.communicate(timeout=480) for p in procs]
     assert all(p.returncode == 0 for p in procs), \
         [(p.returncode, o[1][-800:]) for p, o in zip(procs, outs)]
     outs = [o[0] for o in outs]
@@ -53,3 +53,15 @@ def test_two_process_mesh_crack_step():
         # an all-invalid shard on one host must not desync the slice:
         # the other host's find still lands on both
         assert f"PAD {pid} finds=1 psk=padlock-psk7" in out, (pid, out)
+        # device-rules across processes: the 'u' find (process 1's rows)
+        # decodes from the replicated bitmask on both hosts, and the
+        # host-tail '@b' find (process 0's block) crosses hosts through
+        # the candidate exchange
+        assert f"RULES {pid} finds=RULEBASE19X,rulease02x" in out, (pid, out)
+        # every verify kind (PMKID + keyver 1/2/3) through the mixed
+        # group assembly, each find decoded cross-host
+        assert f"MIXED {pid} finds=4 keyvers=1,2,3,100" in out, (pid, out)
+        # more owned hits than the per-round exchange cap: two
+        # fixed-shape candidate-exchange rounds, no hit dropped
+        assert f"DENSE {pid} finds=1 psk=densepsk77 rounds=2" in out, \
+            (pid, out)

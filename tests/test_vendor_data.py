@@ -1,0 +1,155 @@
+"""Deployment-data vendor keygen packs (gen/vendor_data.py).
+
+All constants in these packs are SYNTHETIC — the pack mechanism is the
+capability under test (the routerkeygen data-pack equivalent); real ISP
+tables are deployment data (see the PARITY.md family classification).
+"""
+
+import hashlib
+import json
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.gen import vendors as V
+from dwpa_tpu.gen.vendor_data import load_vendor_pack
+from dwpa_tpu.server import Database, ServerCore
+from dwpa_tpu.server.jobs import keygen_precompute
+
+BSSID = bytes.fromhex("0011AA22BB33")
+
+
+def _one(pack_entry, ssid, bssid=BSSID):
+    fams = load_vendor_pack({"families": [pack_entry]})
+    return list(fams[0](bssid, ssid))
+
+
+def test_fixed_family():
+    got = _one({"name": "SynthFixed", "ssid_re": r"^SynthNet",
+                "kind": "fixed", "keys": ["synthkey01", "synthkey02"]},
+               b"SynthNet-7")
+    assert got == [("SynthFixed", b"synthkey01"),
+                   ("SynthFixed", b"synthkey02")]
+    # non-matching SSID: silent, no candidates
+    assert _one({"name": "SynthFixed", "ssid_re": r"^SynthNet",
+                 "kind": "fixed", "keys": ["synthkey01"]}, b"Other") == []
+
+
+def test_mac_map_family():
+    got = _one({"name": "SynthMac", "ssid_re": r"^MacNet",
+                "kind": "mac_map", "slices": [[4, 12]], "case": "upper",
+                "prefix": "PP", "offsets": [0, 1]}, b"MacNet_33")
+    assert got[0] == ("SynthMac", b"PP" + BSSID.hex().upper()[4:].encode())
+    nxt = (int.from_bytes(BSSID, "big") + 1).to_bytes(6, "big")
+    assert got[1] == ("SynthMac", b"PP" + nxt.hex().upper()[4:].encode())
+
+
+def test_hash_map_family_hex_and_charset():
+    # hex rendering over literal + MAC-string + SSID group
+    entry = {"name": "SynthHash", "ssid_re": r"^HashNet-(\d+)$",
+             "kind": "hash_map", "hash": "md5",
+             "input": ["seedX", "@MAC", "@ssid_group1"],
+             "take": 10, "charset": "hex"}
+    got = _one(entry, b"HashNet-42")
+    exp = hashlib.md5(b"seedX" + BSSID.hex().upper().encode() + b"42")
+    assert got == [("SynthHash", exp.hexdigest()[:10].encode())]
+
+    # alphabet rendering over a binary magic + raw MAC bytes, with skip
+    entry2 = {"name": "SynthAlpha", "ssid_re": r"^AlphaNet",
+              "kind": "hash_map", "hash": "sha256",
+              "input": ["hex:c0ffee", "@mac_bytes"],
+              "skip": 2, "take": 8, "charset": "abcdefgh"}
+    got2 = _one(entry2, b"AlphaNet")
+    d = hashlib.sha256(bytes.fromhex("c0ffee") + BSSID).digest()[2:]
+    exp2 = "".join("abcdefgh"[b % 8] for b in d[:8]).encode()
+    assert got2 == [("SynthAlpha", exp2)]
+
+
+def test_hash_map_group_bits_rendering():
+    """5-bit-group base-32 rendering (the Fastweb-style bitstream
+    archetype): groups are consumed MSB-first across byte boundaries."""
+    alpha = "0123456789abcdefghijklmnopqrstuv"  # 32 chars
+    entry = {"name": "SynthBits", "ssid_re": r"^BitsNet",
+             "kind": "hash_map", "hash": "md5",
+             "input": ["bitseed", "@mac_bytes"],
+             "take": 10, "charset": alpha, "group_bits": 5}
+    got = _one(entry, b"BitsNet")
+    digest = hashlib.md5(b"bitseed" + BSSID).digest()
+    stream = int.from_bytes(digest, "big")
+    exp = "".join(
+        alpha[(stream >> (128 - 5 * (i + 1))) & 31] for i in range(10)
+    ).encode()
+    assert got == [("SynthBits", exp)]
+
+
+def test_serial_hash_family_with_magic_override():
+    entry = {"name": "SynthAGPF", "ssid_re": r"^SerNet-(\d{8})$",
+             "kind": "serial_hash",
+             "series": {"96": [{"sn": "55501", "q": 0, "k": 1}]},
+             "magic_hex": "aa" * 32, "charset": "0123456789", "take": 12}
+    got = _one(entry, b"SerNet-96001234")
+    assert len(got) == 3  # BSSID neighbourhood 0/+1/-1
+    exp = V.alice_agpf_key("55501X%07d" % 96001234, BSSID,
+                           magic=b"\xaa" * 32, charset="0123456789",
+                           take=12)
+    assert got[0] == ("SynthAGPF", exp) and len(exp) == 12
+
+
+def test_pack_validation_rejects_bad_kind():
+    with pytest.raises(ValueError, match="unknown vendor-pack kind"):
+        load_vendor_pack({"families": [
+            {"name": "x", "ssid_re": ".", "kind": "nope"}]})
+    with pytest.raises(KeyError):  # missing required field fails at load
+        load_vendor_pack({"families": [
+            {"name": "x", "ssid_re": "^V", "kind": "mac_map"}]})
+
+
+def test_pack_validation_checks_data_at_load():
+    """Value errors must surface at load — not on the first matching net
+    mid-cron (the jobs loop would retry the failing tick forever)."""
+    bad = [
+        {"name": "h", "ssid_re": "^A", "kind": "hash_map",
+         "hash": "sha512", "input": ["x"], "take": 4},     # unknown hash
+        {"name": "h", "ssid_re": "^A", "kind": "hash_map",
+         "input": ["hex:zz"], "take": 4},                  # bad hex magic
+        {"name": "h", "ssid_re": "^A", "kind": "hash_map",
+         "input": ["@ssid_group2"], "take": 4},            # no such group
+        {"name": "h", "ssid_re": "^A", "kind": "hash_map",
+         "input": ["@nonsense"], "take": 4},               # unknown token
+        {"name": "m", "ssid_re": "^A", "kind": "mac_map",
+         "slices": [[4, 99]]},                             # slice range
+        {"name": "s", "ssid_re": "^A", "kind": "serial_hash",
+         "series": {}, "magic_hex": "xyz"},                # bad magic_hex
+    ]
+    for entry in bad:
+        with pytest.raises((ValueError, KeyError)):
+            load_vendor_pack({"families": [entry]})
+
+
+def test_pack_file_load_and_precompute_end_to_end(tmp_path):
+    """A file pack flows through the server CLI seam: keygen precompute
+    cracks a net whose PSK only a pack family generates, records the
+    pack's algo label, and the rkg log carries the candidates."""
+    db = Database(":memory:")
+    core = ServerCore(db, dictdir=str(tmp_path / "d"),
+                      capdir=str(tmp_path / "c"))
+    pack = {"families": [{
+        "name": "SynthPack", "ssid_re": r"^PackNet",
+        "kind": "hash_map", "hash": "sha1",
+        "input": ["packseed", "@mac"], "take": 12, "charset": "hex"}]}
+    path = tmp_path / "pack.json"
+    path.write_text(json.dumps(pack))
+    fams = load_vendor_pack(str(path))
+
+    psk = hashlib.sha1(
+        b"packseed" + BSSID.hex().encode()).hexdigest()[:12].encode()
+    line = tfx.make_pmkid_line(psk, b"PackNet_1", seed="vdp", mac_ap=BSSID)
+    core.add_hashlines([line])
+    stats = keygen_precompute(
+        core, extra_generators=[V.vendor_candidates] + fams)
+    assert stats["cracked"] == 1
+    row = core.db.q1("SELECT * FROM nets")
+    assert row["n_state"] == 1 and row["pass"] == psk
+    assert row["algo"] == "SynthPack"
+    assert core.db.q1(
+        "SELECT COUNT(*) c FROM rkg WHERE algo = 'SynthPack'")["c"] >= 1
