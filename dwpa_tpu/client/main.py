@@ -43,6 +43,33 @@ PACE_TARGET_S = 900.0  # work-unit pacing target (reference autotune threshold)
 CHALLENGE_PSK = b"aaaa1234"
 
 
+def _broadcast_json(obj):
+    """Process 0's JSON-serializable ``obj`` (or None) to every host.
+
+    The multi-host client contract (parallel/mesh.py multihost_mesh: a
+    slice is "one very large volunteer"): exactly one host talks to the
+    server per decision, and every host must then act on IDENTICAL data
+    or the first shard_map collective deadlocks.  Two fixed-shape
+    broadcasts: the byte length (-1 = None), then the padded payload —
+    broadcast_one_to_all requires equal shapes on every host, so the
+    length must be agreed before the buffer exists.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    pid = jax.process_index()
+    data = b"" if obj is None else json.dumps(obj).encode()
+    n = int(mhu.broadcast_one_to_all(
+        np.int64(-1 if pid == 0 and obj is None else len(data))))
+    if n < 0:
+        return None
+    buf = np.zeros(n, np.uint8)
+    if pid == 0:
+        buf[:n] = np.frombuffer(data, np.uint8)
+    buf = np.asarray(mhu.broadcast_one_to_all(buf))
+    return json.loads(buf.tobytes().decode())
+
+
 def version_tuple(v: str):
     """Order dotted versions with optional alpha suffixes, matching the
     reference's numeric+alpha compare (help_crack.py:128-156)."""
@@ -88,6 +115,14 @@ class TpuCrackClient:
         self.cfg = config
         self.api = api or ServerAPI(config.base_url)
         self.log = log
+        if config.additional_dict and jax.process_count() > 1:
+            # A per-host local file cannot feed a multi-host slice: the
+            # pass-1 streams must be byte-identical on every host or the
+            # shard_map collectives deadlock (same reason the cracked/rkg
+            # snapshots are digest-checked).  Publish it as a server dict.
+            raise SystemExit(
+                "additional_dict is host-local; on a multi-host mesh "
+                "publish it as a server dictionary instead")
         os.makedirs(config.workdir, exist_ok=True)
         self.dictdir = os.path.join(config.workdir, "dicts")
         os.makedirs(self.dictdir, exist_ok=True)
@@ -290,10 +325,36 @@ class TpuCrackClient:
             except (ConnectionError, ValueError, OSError):
                 pass
         self._cracked_countdown -= 1
-        for path in (cracked, rkg):
-            if os.path.exists(path):
-                stream = DictStream(path)
-                yield from (apply_rules(rules, stream, workers=self.cfg.rule_workers)
+        files = [p for p in (cracked, rkg) if os.path.exists(p)]
+        if jax.process_count() > 1:
+            # cracked/rkg are NOT md5-pinned (best-effort artifacts), so
+            # a server-side regen between two hosts' downloads could hand
+            # the slice different bytes — the pass-1 streams would then
+            # diverge in length and the shard_map collectives deadlock.
+            # allgather (not a host-0 broadcast: host 0's view always
+            # matches itself) so EVERY host sees every digest and all
+            # raise together instead of stranding the one that noticed.
+            import hashlib as _hl
+
+            import numpy as _np
+            from jax.experimental import multihost_utils as mhu
+
+            h = _hl.md5()
+            for p in files:
+                h.update(os.path.basename(p).encode() + b"\0")
+                with open(p, "rb") as f:
+                    h.update(f.read())
+            alld = _np.asarray(mhu.process_allgather(
+                _np.frombuffer(h.digest(), _np.uint8))).reshape(-1, 16)
+            if not (alld == alld[0]).all():
+                raise RuntimeError(
+                    "multi-host pass-1 dict snapshot mismatch (cracked/rkg "
+                    "raced a server regen) — delete the local copies and "
+                    f"restart the unit; digests: {[r.tobytes().hex() for r in alld]}"
+                )
+        for path in files:
+            stream = DictStream(path)
+            yield from (apply_rules(rules, stream, workers=self.cfg.rule_workers)
                         if rules else stream)
 
     def _rules(self, work: dict):
@@ -335,12 +396,20 @@ class TpuCrackClient:
             # submissions, so re-fetching after a crash would misalign the
             # resume's skip-by-count fast-forward.  The snapshot rides
             # every checkpoint write, making the stream deterministic.
+            # Multi-host: only process 0 queries (the unordered result
+            # MUST be byte-identical on every host or the pass-1 stream
+            # lengths diverge and the shard_map collectives desync).
             if "_prdict_cache" not in work:
-                try:
-                    words = self.api.get_prdict(work["hkey"])
-                except (ConnectionError, ValueError):
-                    words = []
-                work["_prdict_cache"] = [w.hex() for w in words]
+                hexes = None
+                if jax.process_index() == 0:
+                    try:
+                        words = self.api.get_prdict(work["hkey"])
+                    except (ConnectionError, ValueError):
+                        words = []
+                    hexes = [w.hex() for w in words]
+                if jax.process_count() > 1:
+                    hexes = _broadcast_json(hexes) or []
+                work["_prdict_cache"] = hexes
                 self._write_resume(work)
             for wx in work["_prdict_cache"]:
                 yield oracle.hc_unhex(bytes.fromhex(wx))
@@ -474,7 +543,26 @@ class TpuCrackClient:
             {"k": f.line.mac_ap.hex(), "v": f.psk.hex()} for f in founds
         ]
         cand = [dict(t) for t in {tuple(sorted(c.items())) for c in cand}]
-        result.accepted = self.api.put_work(work["hkey"], cand)
+        if jax.process_count() > 1:
+            # One submission per slice: process 0 talks to the server,
+            # every host adopts its verdict (all hosts decoded identical
+            # founds, so the payload would be identical anyway).  A
+            # host-0 exception must broadcast as an error sentinel — the
+            # peers are already parked in the broadcast and would hang
+            # forever if host 0 just raised.
+            acc = err = None
+            if jax.process_index() == 0:
+                try:
+                    acc = self.api.put_work(work["hkey"], cand)
+                except Exception as e:
+                    err = f"{type(e).__name__}: {e}"
+            payload = _broadcast_json({"acc": acc, "err": err})
+            if payload["err"]:
+                raise ConnectionError(
+                    f"put_work failed on host 0: {payload['err']}")
+            result.accepted = bool(payload["acc"])
+        else:
+            result.accepted = self.api.put_work(work["hkey"], cand)
         self._clear_resume()
         self._autotune(elapsed)
         return result
@@ -486,18 +574,56 @@ class TpuCrackClient:
             self.dictcount -= 1
 
     def run(self) -> int:
-        """Update-check + challenge-gate, then loop work units."""
-        if self.check_update():
+        """Update-check + challenge-gate, then loop work units.
+
+        Multi-host mode (``jax.process_count() > 1`` — a
+        ``multihost_mesh`` slice acting as ONE very large volunteer):
+        process 0 owns every server decision (update probe, resume read,
+        get_work, put_work) and broadcasts the outcome, so all hosts
+        crack the SAME unit in SPMD lockstep; dict downloads stay
+        per-host (md5-pinned, so the bytes are identical).  The engines
+        span the global mesh automatically (parallel/mesh.default_mesh).
+        """
+        multiproc = jax.process_count() > 1
+        pid = jax.process_index()
+        upd = self.check_update() if pid == 0 else False
+        if multiproc:
+            upd = bool(_broadcast_json(upd))
+        if upd:
             raise SystemExit("client update downloaded; restart to apply")
         if not self.challenge():
             raise SystemExit("challenge failed: cracker output untrusted")
         done = 0
         while not self.cfg.max_work_units or done < self.cfg.max_work_units:
-            work = self._read_resume()
-            if work is None:
-                try:
-                    work = self.api.get_work(self.dictcount)
-                except NoNets:
+            if not multiproc:
+                work = self._read_resume()
+                if work is None:
+                    try:
+                        work = self.api.get_work(self.dictcount)
+                    except NoNets:
+                        self.log("no nets available; sleeping")
+                        self.api.sleep(self.api.backoff)
+                        continue
+            else:
+                # Host-0 server errors (version gate, malformed work)
+                # must reach every host as a sentinel: the peers are
+                # already parked in the broadcast, and a bare raise on
+                # host 0 would strand them without a message.
+                payload = {"work": None, "err": None}
+                if pid == 0:
+                    try:
+                        payload["work"] = (self._read_resume()
+                                           or self.api.get_work(self.dictcount))
+                    except NoNets:
+                        pass
+                    except Exception as e:
+                        payload["err"] = f"{type(e).__name__}: {e}"
+                payload = _broadcast_json(payload)
+                if payload["err"]:
+                    raise SystemExit(
+                        f"get_work failed on host 0: {payload['err']}")
+                work = payload["work"]
+                if work is None:
                     self.log("no nets available; sleeping")
                     self.api.sleep(self.api.backoff)
                     continue

@@ -8,14 +8,18 @@ PSK lives on process 1, so process 0 only sees the hit through the
 cross-host psum — the collective the whole multi-host design rides on.
 """
 
+import gzip
+import hashlib
 import os
 import socket
 import subprocess
 import sys
+import threading
 
 import pytest
 
 WORKER = os.path.join(os.path.dirname(__file__), "mh_worker.py")
+CLIENT_WORKER = os.path.join(os.path.dirname(__file__), "mh_client_worker.py")
 
 
 def _free_port() -> int:
@@ -65,3 +69,70 @@ def test_two_process_mesh_crack_step():
         # fixed-shape candidate-exchange rounds, no hit dropped
         assert f"DENSE {pid} finds=1 psk=densepsk77 rounds=2" in out, \
             (pid, out)
+
+
+def test_two_process_client_single_volunteer(tmp_path):
+    """The full CLIENT as one multi-host volunteer: a real socket server
+    in this process, two client processes spanning one jax.distributed
+    mesh.  Process 0 makes every server call exactly once (update probe,
+    get_work, put_work); process 1 receives the unit only through the
+    client's broadcast layer; the PSK is reachable only via a device
+    rule, so pass 2 runs the sharded fused rules step across both
+    hosts' devices — and the net ends cracked server-side."""
+    from wsgiref.simple_server import WSGIServer, make_server
+    import socketserver
+
+    from dwpa_tpu import testing as tfx
+    from dwpa_tpu.rules import parse_rule
+    from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+    core = ServerCore(Database(str(tmp_path / "wpa.db")),
+                      dictdir=str(tmp_path / "dicts"),
+                      capdir=str(tmp_path / "caps"))
+    os.makedirs(core.dictdir, exist_ok=True)
+    base = [b"mhcword%03d" % i for i in range(40)]
+    psk = parse_rule("u").apply(base[23])  # only a device rule reaches it
+    core.add_hashlines([tfx.make_pmkid_line(psk, b"MhcNet", seed="mhc")])
+    blob = gzip.compress(b"\n".join(base) + b"\n")
+    path = os.path.join(core.dictdir, "mhc.txt.gz")
+    open(path, "wb").write(blob)
+    core.add_dict("dict/mhc.txt.gz", "mhc.txt.gz",
+                  hashlib.md5(blob).hexdigest(), len(base), rules="u\n$Z")
+    core.db.x("UPDATE nets SET algo = ''")
+
+    hits = {"get_work": 0, "put_work": 0}
+    app = make_wsgi_app(core)
+
+    def counting_app(environ, start_response):
+        q = environ.get("QUERY_STRING", "")
+        for k in hits:
+            if k in q:
+                hits[k] += 1
+        return app(environ, start_response)
+
+    class TS(socketserver.ThreadingMixIn, WSGIServer):
+        daemon_threads = True
+
+    srv = make_server("127.0.0.1", 0, counting_app, server_class=TS)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        coord = str(_free_port())
+        procs = [
+            subprocess.Popen(
+                [sys.executable, CLIENT_WORKER, str(pid), coord,
+                 str(srv.server_address[1]), str(tmp_path)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for pid in (0, 1)
+        ]
+        outs = [p.communicate(timeout=540) for p in procs]
+    finally:
+        srv.shutdown()
+    assert all(p.returncode == 0 for p in procs), \
+        [(p.returncode, o[1][-1500:]) for p, o in zip(procs, outs)]
+    for pid, (out, _err) in enumerate(outs):
+        assert f"MHCLIENT {pid} done=1 pot=yes" in out, (pid, out)
+    row = core.db.q1("SELECT n_state, pass FROM nets")
+    assert row["n_state"] == 1 and row["pass"] == psk
+    # one volunteer, one conversation: process 0 only
+    assert hits == {"get_work": 1, "put_work": 1}, hits
