@@ -188,10 +188,19 @@ def bench_rules_device(batch: int, n_rules: int = 8,
     # the timed run measures steady state, not one-time XLA compiles.
     engine.crack_rules([b"warm%07d" % i for i in range(batch)],
                        [rules[0], rules[-1]])
-    t0 = time.perf_counter()
-    founds = engine.crack_rules(base, rules)
-    dt = time.perf_counter() - t0
-    assert founds and founds[0].psk == psk, "rules_device missed the PSK"
+    # Best of 2 (fresh engine per rep so the find doesn't shrink rep 2):
+    # one transient ~20 s tunnel stall must not misrecord the steady rate
+    # (see bench_dict_steady).
+    dts = []
+    for _ in range(2):
+        eng = M22000Engine(
+            [T.make_pmkid_line(psk, b"bench-essid", seed="rulesdev")],
+            batch_size=batch,
+        )
+        founds = []
+        dts.append(_timed(lambda: founds.extend(eng.crack_rules(base, rules))))
+        assert founds and founds[0].psk == psk, "rules_device missed the PSK"
+    dt = min(dts)
     n = len(base) * len(rules)
     return {"label": "rules_device", "candidates": n, "rules": len(rules),
             "batches": n_flush, "seconds": dt, "cand_per_s": n / dt}
@@ -227,18 +236,26 @@ def bench_dict_steady(batch: int, batches: int = 8) -> dict:
     """Engine product path at full batch: streaming dict crack with the
     three-deep pipeline (pack + H2D + hits-gate overlapped with compute).
     The gap to mask_pbkdf2 is the end-to-end overhead the engine fails
-    to hide."""
+    to hide.  Best of 2: the tunnel occasionally stalls one transfer for
+    ~20 s (measured: identical back-to-back runs of 24 s vs 45 s), and a
+    steady-state figure must not record a one-off hiccup."""
     engine = M22000Engine(
         [T.make_pmkid_line(b"steadypass9", b"bench-steady", seed="st")],
         batch_size=batch,
     )
     engine.crack_batch([b"warm-%07d" % i for i in range(batch)])
     n = batches * batch
-    t0 = time.perf_counter()
-    engine.crack(b"run-%08d" % i for i in range(n))
-    dt = time.perf_counter() - t0
+    dt = min(_timed(lambda: engine.crack(b"r%d-%08d" % (rep, i)
+                                         for i in range(n)))
+             for rep in range(2))
     return {"label": "dict_steady", "words": n, "seconds": dt,
             "pmk_per_s": n / dt}
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def bench_host_feed(words: int = 200_000) -> dict:

@@ -70,6 +70,24 @@ def _broadcast_json(obj):
     return json.loads(buf.tobytes().decode())
 
 
+def _allgather_strs(s: str, width: int = 256):
+    """Every host's (truncated) string, in process order.
+
+    The fixed width keeps ``process_allgather``'s equal-shape contract
+    without a length negotiation; used for slice-wide agreement checks
+    (versions, digests, error flags) where every host MUST reach the
+    collective — a raise before it would strand the peers inside it.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    buf = np.zeros(width, np.uint8)
+    b = (s or "").encode()[:width]
+    buf[: len(b)] = np.frombuffer(b, np.uint8)
+    rows = np.asarray(mhu.process_allgather(buf)).reshape(-1, width)
+    return [bytes(r).rstrip(b"\0").decode("utf-8", "replace") for r in rows]
+
+
 def version_tuple(v: str):
     """Order dotted versions with optional alpha suffixes, matching the
     reference's numeric+alpha compare (help_crack.py:128-156)."""
@@ -133,6 +151,7 @@ class TpuCrackClient:
 
         enable_compilation_cache(os.path.join(config.workdir, "xla_cache"))
         self.resume_path = os.path.join(config.workdir, "resume.json")
+        self._digest_cache = {}  # (path, size, mtime_ns) -> md5 hex
         self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
         self.dictcount = max(1, min(15, config.dictcount))
         # cracked/rkg refresh countdown: primed to refresh on first use,
@@ -272,6 +291,25 @@ class TpuCrackClient:
         self._clear_resume()
         return None
 
+    def _file_digest(self, path: str) -> str:
+        """md5 of a workdir file, cached by (size, mtime): the cracked/
+        rkg snapshots only change on the refresh cadence, and the
+        multi-host agreement check runs every unit — re-hashing a
+        many-MB file per unit per host would tax the crack loop for no
+        information."""
+        import hashlib
+
+        st = os.stat(path)
+        key = (path, st.st_size, st.st_mtime_ns)
+        hit = self._digest_cache.get(key)
+        if hit is None:
+            h = hashlib.md5()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            hit = self._digest_cache[key] = h.hexdigest()
+        return hit
+
     def _fetch_dicts(self, work: dict) -> list:
         """Download (or reuse cached) pass-2 work dicts; returns local
         paths.  cracked.txt.gz is excluded — it runs in pass 1 via
@@ -334,24 +372,14 @@ class TpuCrackClient:
             # allgather (not a host-0 broadcast: host 0's view always
             # matches itself) so EVERY host sees every digest and all
             # raise together instead of stranding the one that noticed.
-            import hashlib as _hl
-
-            import numpy as _np
-            from jax.experimental import multihost_utils as mhu
-
-            h = _hl.md5()
-            for p in files:
-                h.update(os.path.basename(p).encode() + b"\0")
-                with open(p, "rb") as f:
-                    h.update(f.read())
-            alld = _np.asarray(mhu.process_allgather(
-                _np.frombuffer(h.digest(), _np.uint8))).reshape(-1, 16)
-            if not (alld == alld[0]).all():
+            mine = ",".join(
+                f"{os.path.basename(p)}:{self._file_digest(p)}" for p in files)
+            alld = _allgather_strs(mine)
+            if len(set(alld)) != 1:
                 raise RuntimeError(
                     "multi-host pass-1 dict snapshot mismatch (cracked/rkg "
                     "raced a server regen) — delete the local copies and "
-                    f"restart the unit; digests: {[r.tobytes().hex() for r in alld]}"
-                )
+                    f"restart the unit; digests: {alld}")
         for path in files:
             stream = DictStream(path)
             yield from (apply_rules(rules, stream, workers=self.cfg.rule_workers)
@@ -404,7 +432,10 @@ class TpuCrackClient:
                 if jax.process_index() == 0:
                     try:
                         words = self.api.get_prdict(work["hkey"])
-                    except (ConnectionError, ValueError):
+                    except (ConnectionError, ValueError, OSError):
+                        # OSError covers gzip.BadGzipFile etc.; a host-0
+                        # raise here would strand the peers already
+                        # parked in the broadcast below
                         words = []
                     hexes = [w.hex() for w in words]
                 if jax.process_count() > 1:
@@ -447,8 +478,27 @@ class TpuCrackClient:
     def _pass2_words(self, work: dict):
         """Pass-2 BASE words: the remaining server dicts, in work-unit
         order.  Downloads happen lazily when the stream reaches a dict,
-        so a resume skipping pass 1 still fetches them."""
-        for path in self._fetch_dicts(work):
+        so a resume skipping pass 1 still fetches them.
+
+        Multi-host: a download failure on ONE host (e.g. the md5 gate
+        tripping because the server regenerated a dict between two
+        hosts' fetches) must abort the whole slice loudly — every host
+        reaches the allgather below even on failure, then all raise
+        together instead of one host crashing out of the stream while
+        its peers block in the crack collectives."""
+        err = None
+        try:
+            paths = self._fetch_dicts(work)
+        except (ConnectionError, ValueError, OSError) as e:
+            if jax.process_count() <= 1:
+                raise
+            err, paths = f"{type(e).__name__}: {e}", []
+        if jax.process_count() > 1:
+            errs = [e for e in _allgather_strs(err or "") if e]
+            if errs:
+                raise RuntimeError(
+                    f"pass-2 dict fetch failed on the slice: {errs}")
+        for path in paths:
             yield from DictStream(path)
 
     def process_work(self, work: dict) -> WorkResult:
@@ -583,12 +633,30 @@ class TpuCrackClient:
         crack the SAME unit in SPMD lockstep; dict downloads stay
         per-host (md5-pinned, so the bytes are identical).  The engines
         span the global mesh automatically (parallel/mesh.default_mesh).
+        Pass 1 runs replicated — every host feeds the identical targeted
+        stream as its local shard, costing nproc× redundant PBKDF2 on
+        the (small) pass-1 candidate set; pass 2, where the volume is,
+        shards for real (crack_rules' global-stream contract).
         """
         multiproc = jax.process_count() > 1
         pid = jax.process_index()
-        upd = self.check_update() if pid == 0 else False
         if multiproc:
-            upd = bool(_broadcast_json(upd))
+            # A mixed-version slice is fatal-by-design (stream order is
+            # version-dependent — see _write_resume), so agreement is
+            # checked BEFORE any work, where the failure is a clear exit
+            # rather than a mid-unit collective deadlock.
+            vers = _allgather_strs(__version__)
+            if len(set(vers)) != 1:
+                raise SystemExit(
+                    f"mixed client versions across the slice: {vers}; "
+                    "upgrade every host to the same build")
+        # Every host probes/downloads (HTTP only, no collectives), so an
+        # update lands on all of them; process 0's verdict alone decides
+        # the restart, and the version check above catches any host whose
+        # download failed once the supervisor swaps the archives in.
+        upd = self.check_update()
+        if multiproc:
+            upd = bool(_broadcast_json(upd if pid == 0 else None))
         if upd:
             raise SystemExit("client update downloaded; restart to apply")
         if not self.challenge():

@@ -28,6 +28,21 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _communicate_all(procs, timeout):
+    """communicate() on every worker, killing ALL of them if any hangs:
+    a collective desync (the bug class these tests exist to catch) parks
+    the workers in a jax collective forever — they must not outlive the
+    test holding CPUs and the coordinator port."""
+    try:
+        return [p.communicate(timeout=timeout) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=30)
+        raise
+
+
 def test_two_process_mesh_crack_step():
     port = str(_free_port())
     procs = [
@@ -37,7 +52,7 @@ def test_two_process_mesh_crack_step():
         )
         for pid in (0, 1)
     ]
-    outs = [p.communicate(timeout=480) for p in procs]
+    outs = _communicate_all(procs, timeout=480)
     assert all(p.returncode == 0 for p in procs), \
         [(p.returncode, o[1][-800:]) for p, o in zip(procs, outs)]
     outs = [o[0] for o in outs]
@@ -125,7 +140,7 @@ def test_two_process_client_single_volunteer(tmp_path):
             )
             for pid in (0, 1)
         ]
-        outs = [p.communicate(timeout=540) for p in procs]
+        outs = _communicate_all(procs, timeout=540)
     finally:
         srv.shutdown()
     assert all(p.returncode == 0 for p in procs), \
