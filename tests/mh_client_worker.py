@@ -35,7 +35,14 @@ def main():
                    num_processes=2, process_id=pid)
     assert jax.process_count() == 2
 
+    import dwpa_tpu
+    import dwpa_tpu.client.main as cm
     from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+
+    if len(sys.argv) > 5 and sys.argv[5]:
+        # simulate a host running a different client build (the
+        # mixed-version negative test): the slice must refuse to start
+        dwpa_tpu.__version__ = cm.__version__ = sys.argv[5]
 
     cfg = ClientConfig(
         base_url=f"http://127.0.0.1:{http_port}/",

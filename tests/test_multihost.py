@@ -86,6 +86,25 @@ def test_two_process_mesh_crack_step():
             (pid, out)
 
 
+def test_mixed_version_slice_refuses_to_start(tmp_path):
+    """A slice whose hosts run different client builds must exit with a
+    clear error on EVERY host before any work — stream order is
+    version-dependent, so proceeding would desync the collectives."""
+    coord = str(_free_port())
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CLIENT_WORKER, str(pid), coord, "1",
+             str(tmp_path)] + (["0.0.0-mixed"] if pid == 1 else []),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = _communicate_all(procs, timeout=240)
+    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode != 0, (pid, out, err)
+        assert "mixed client versions" in err, (pid, err[-800:])
+
+
 def test_two_process_client_single_volunteer(tmp_path):
     """The full CLIENT as one multi-host volunteer: a real socket server
     in this process, two client processes spanning one jax.distributed
