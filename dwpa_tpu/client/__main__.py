@@ -26,13 +26,39 @@ def build_parser():
                    help="nonce-error-correction budget (reference -co "
                         "--nonce-error-corrections, help_crack.py:773)")
     p.add_argument("--rule-workers", type=int, default=0,
-                   help="expand rules in N worker processes (feeds a "
-                        "multi-chip mesh; 0 = inline)")
+                   help="expand PASS-1 rules (cracked/rkg dicts) in N "
+                        "worker processes; pass 2 mangles on device "
+                        "(0 = inline)")
+    p.add_argument("--multihost", action="store_true",
+                   help="join a jax.distributed slice before any engine "
+                        "work (TPU pod environment auto-detected); the "
+                        "slice then acts as ONE volunteer — process 0 "
+                        "owns the server conversation")
+    p.add_argument("--coordinator",
+                   help="manual cluster coordinator host:port (implies "
+                        "--multihost; pair with --num-processes and "
+                        "--process-id)")
+    p.add_argument("--num-processes", type=int, help="manual cluster size")
+    p.add_argument("--process-id", type=int, help="this host's rank")
     return p
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    manual = (args.coordinator, args.num_processes, args.process_id)
+    if args.multihost or any(v is not None for v in manual):
+        if any(v is not None for v in manual) and None in manual:
+            parser.error("--coordinator, --num-processes and --process-id "
+                         "must be given together for a manual cluster")
+        # Must run before anything touches the XLA backend (engine
+        # construction included); multihost_mesh owns the init-ordering
+        # contract for both the manual and the auto-detected path.
+        from ..parallel.mesh import multihost_mesh
+
+        multihost_mesh(coordinator=args.coordinator,
+                       num_processes=args.num_processes,
+                       process_id=args.process_id, auto_init=True)
     cfg = ClientConfig(
         base_url=args.base_url,
         workdir=args.workdir,

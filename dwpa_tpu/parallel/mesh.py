@@ -57,7 +57,7 @@ def shard_vector(mesh: Mesh, v):
 
 
 def multihost_mesh(coordinator: str = None, num_processes: int = None,
-                   process_id: int = None) -> Mesh:
+                   process_id: int = None, auto_init: bool = False) -> Mesh:
     """A 1-D dp mesh spanning every chip of a multi-host slice.
 
     The distributed backend analog of the reference's NCCL/MPI role
@@ -83,11 +83,13 @@ def multihost_mesh(coordinator: str = None, num_processes: int = None,
         kw["num_processes"] = num_processes
     if process_id is not None:
         kw["process_id"] = process_id
-    if (coordinator is not None or num_processes is not None) and (
-        not jax.distributed.is_initialized()
-    ):
-        # Must run before anything touches the XLA backend (even
-        # jax.process_count() would initialise it), hence the check
-        # against the distributed-service state rather than device APIs.
+    # ``auto_init``: join with zero args, letting jax auto-detect the
+    # cluster from the managed environment (TPU pod slices) — the one
+    # blessed slice-join path for callers with no explicit topology
+    # (client CLI --multihost).  Either way the init must run before
+    # anything touches the XLA backend (even jax.process_count() would
+    # initialise it), hence the check against the distributed-service
+    # state rather than device APIs.
+    if (auto_init or kw) and not jax.distributed.is_initialized():
         jax.distributed.initialize(**kw)
     return Mesh(np.asarray(jax.devices()), (DP_AXIS,))

@@ -413,6 +413,30 @@ def test_rules_unit_resume_mid_pass2(server, tmp_path):
     assert server.db.q1("SELECT n_state FROM nets")["n_state"] == 1
 
 
+def test_client_cli_multihost_flags():
+    """The CLI exposes the slice-join knobs (INSTALL.md multi-host
+    recipe) without touching single-process defaults."""
+    from dwpa_tpu.client.__main__ import build_parser
+
+    a = build_parser().parse_args(["http://s/"])
+    assert not a.multihost and a.coordinator is None
+    a = build_parser().parse_args(["http://s/", "--multihost"])
+    assert a.multihost
+    a = build_parser().parse_args(
+        ["http://s/", "--coordinator", "h0:8476",
+         "--num-processes", "2", "--process-id", "1"])
+    assert (a.coordinator, a.num_processes, a.process_id) == ("h0:8476", 2, 1)
+    # a partial manual-cluster spec is a usage error, not a deep JAX
+    # traceback (and never a silently-ignored flag)
+    from dwpa_tpu.client.__main__ import main as cli_main
+
+    for argv in (["http://s/", "--coordinator", "h0:8476"],
+                 ["http://s/", "--num-processes", "2", "--process-id", "1"]):
+        with pytest.raises(SystemExit) as e:
+            cli_main(argv)
+        assert e.value.code == 2  # argparse usage error
+
+
 def test_bundled_wpa_rules_crack_mangled_psk(server, tmp_path):
     """A dict packed with the bundled WPA ruleset cracks a PSK that is a
     base word through a rule ('c $1'), end-to-end over the wire — the
