@@ -29,6 +29,7 @@ import numpy as np
 import jax
 
 from dwpa_tpu import testing as T
+from dwpa_tpu.analysis import watch_compiles
 from dwpa_tpu.models.m22000 import M22000Engine
 
 RTX4090_PMKS = 2.5e6           # hashcat-CUDA m22000 on one RTX 4090
@@ -98,12 +99,16 @@ def bench_mask_pbkdf2(batch: int, batches: int = 8) -> dict:
     mask = "?d?d?d?d?d?d?d?d"
     n = batches * batch
     # Warmup (compile) on a keyspace slice disjoint from the timed run.
+    # The sentinel proves the headline number measures steady state: a
+    # nonzero ``recompiles`` means the timed run paid XLA compile time.
     engine.crack_mask(mask, skip=n, limit=batch)
-    t0 = time.perf_counter()
-    engine.crack_mask(mask, skip=0, limit=n)
-    dt = time.perf_counter() - t0
+    with watch_compiles() as comp:
+        t0 = time.perf_counter()
+        engine.crack_mask(mask, skip=0, limit=n)
+        dt = time.perf_counter() - t0
     return {"pmk_per_s": n / dt, "batch": batch, "batches": batches,
-            "seconds": dt, "candidate_gen": "on-device"}
+            "seconds": dt, "candidate_gen": "on-device",
+            "recompiles": comp.count}
 
 
 def bench_engine_dict(line: str, psk: bytes, words: int, label: str,
@@ -245,11 +250,12 @@ def bench_dict_steady(batch: int, batches: int = 8) -> dict:
     )
     engine.crack_batch([b"warm-%07d" % i for i in range(batch)])
     n = batches * batch
-    dt = min(_timed(lambda: engine.crack(b"r%d-%08d" % (rep, i)
-                                         for i in range(n)))
-             for rep in range(2))
+    with watch_compiles() as comp:
+        dt = min(_timed(lambda: engine.crack(b"r%d-%08d" % (rep, i)
+                                             for i in range(n)))
+                 for rep in range(2))
     return {"label": "dict_steady", "words": n, "seconds": dt,
-            "pmk_per_s": n / dt}
+            "pmk_per_s": n / dt, "recompiles": comp.count}
 
 
 def _timed(fn) -> float:

@@ -1,0 +1,82 @@
+"""dwpa_tpu.analysis — repo-native static analysis + runtime sentinels.
+
+Three layers of defense against the bug species the type system cannot
+see (the round-5 advisor findings were all of this species):
+
+- :mod:`.linter` — AST rules for the JAX hot paths (tracer branches,
+  uncached jits, off-lattice dtypes, hot-path host syncs, unsynced
+  bench timings).  Rule codes DW10x.
+- :mod:`.contracts` — static cross-layer diff of the client protocol
+  fields vs the server handlers vs the sqlite schema.  Codes DW20x.
+- :mod:`.recompile` — runtime recompilation sentinel (context manager
+  + pytest fixture) that counts XLA compile-cache misses and fails a
+  sweep that recompiles per batch.
+
+Run standalone with ``python -m dwpa_tpu.analysis`` (exit 0 = clean
+under the checked-in baseline); tier-1 runs the same pass via
+``tests/test_analysis.py``.  See INSTALL.md ("Static analysis") for
+rule-code interpretation and the baseline-update workflow.
+"""
+
+import os
+
+from .baseline import (DEFAULT_BASELINE, apply_baseline, load_baseline,
+                       write_baseline)
+from .contracts import check_contracts
+from .linter import Violation, lint_source, lint_tree
+from .recompile import (CompileReport, RecompilationError, no_recompiles,
+                        watch_compiles)
+
+__all__ = [
+    "Violation", "lint_source", "lint_tree", "check_contracts",
+    "watch_compiles", "no_recompiles", "RecompilationError",
+    "CompileReport", "load_baseline", "apply_baseline", "write_baseline",
+    "DEFAULT_BASELINE", "repo_root", "run_analysis",
+]
+
+
+def repo_root() -> str:
+    """The tree this package ships in (…/dwpa_tpu/analysis/../..)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def collect_violations(root: str = None) -> list:
+    """Full pass: lint every source file + the cross-layer contracts."""
+    root = root or repo_root()
+    violations = lint_tree(root)
+    try:
+        violations += check_contracts(root)
+    except FileNotFoundError:
+        # a partial tree (e.g. a fixture dir) has no protocol layers
+        pass
+    return violations
+
+
+def run_analysis(root: str = None, baseline_path: str = None,
+                 update_baseline: bool = False, log=print) -> int:
+    """The CLI/test entry point.  Returns a process exit code:
+    0 = clean under the baseline, 1 = new violations."""
+    root = root or repo_root()
+    violations = collect_violations(root)
+    if update_baseline:
+        path = write_baseline(violations, baseline_path)
+        log(f"baseline updated: {len(violations)} accepted violation(s) "
+            f"-> {path}")
+        return 0
+    new, absorbed, stale = apply_baseline(
+        violations, load_baseline(baseline_path))
+    for v in new:
+        log(v.render())
+    if absorbed:
+        log(f"{len(absorbed)} violation(s) absorbed by baseline")
+    if stale:
+        log(f"{len(stale)} stale baseline entrie(s) — fixed violations; "
+            "ratchet with --update-baseline:")
+        for code, path, snippet in stale:
+            log(f"  {code} {path}: {snippet}")
+    if new:
+        log(f"FAILED: {len(new)} new violation(s)")
+        return 1
+    log(f"OK: {len(violations)} violation(s), all baselined")
+    return 0

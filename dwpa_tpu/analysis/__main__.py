@@ -1,0 +1,40 @@
+"""CLI: ``python -m dwpa_tpu.analysis [root] [--update-baseline]``.
+
+Exit codes: 0 = tree is clean under the checked-in baseline,
+1 = new violations (printed one per line as ``path:line: CODE msg``).
+See INSTALL.md ("Static analysis") for the rule-code reference.
+"""
+
+import argparse
+import sys
+
+from . import DEFAULT_BASELINE, repo_root, run_analysis
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="dwpa_tpu.analysis",
+        description="repo-native JAX contract linter + cross-layer "
+                    "protocol/schema drift checker",
+    )
+    p.add_argument("root", nargs="?", default=None,
+                   help="tree to analyze (default: the repo this package "
+                        "ships in)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE})")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="accept the current violation set as the new "
+                        "baseline (use when a flagged line is reviewed "
+                        "and intentional)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return run_analysis(root=args.root or repo_root(),
+                        baseline_path=args.baseline,
+                        update_baseline=args.update_baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Violations baseline: land the linter green, then ratchet.
+
+The baseline (``analysis/baseline.json``, checked in next to this
+module) records the violations the repo has individually accepted —
+e.g. the engine's intentional hits-gate syncs.  A lint run fails only
+on violations NOT absorbed by the baseline, so new hazards are caught
+while accepted ones don't nag; fixing an accepted violation leaves a
+stale baseline entry, which the CLI reports as a ratchet opportunity
+(tighten with ``--update-baseline``) without failing the run.
+
+Entries match on ``(code, path, snippet)`` — the stripped offending
+source line — NOT on line numbers, so unrelated edits moving code
+around a file never churn the baseline, while editing the offending
+line itself forces an explicit re-accept.  Duplicate identical lines
+are handled by multiplicity: an entry absorbs at most ``count``
+matching violations.
+"""
+
+import json
+import os
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str = None) -> dict:
+    """{(code, path, snippet): count}; empty when no baseline exists."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for entry in data.get("violations", []):
+        key = (entry["code"], entry["path"], entry["snippet"])
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def apply_baseline(violations, baseline: dict):
+    """Split ``violations`` into (new, absorbed, stale_entries).
+
+    ``new``: violations no baseline entry absorbs (these fail the run).
+    ``absorbed``: violations covered by the baseline.
+    ``stale_entries``: baseline keys with leftover multiplicity — the
+    violation was fixed; the baseline can ratchet down.
+    """
+    budget = dict(baseline)
+    new, absorbed = [], []
+    for v in violations:
+        key = v.fingerprint()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            absorbed.append(v)
+        else:
+            new.append(v)
+    stale = sorted(k for k, n in budget.items() if n > 0)
+    return new, absorbed, stale
+
+
+def write_baseline(violations, path: str = None):
+    """Serialize the current violation set as the new baseline."""
+    path = path or DEFAULT_BASELINE
+    counts = {}
+    lines = {}
+    for v in violations:
+        key = v.fingerprint()
+        counts[key] = counts.get(key, 0) + 1
+        lines.setdefault(key, v.line)
+    entries = [
+        {"code": code, "path": p, "snippet": snip, "count": n,
+         "line_hint": lines[(code, p, snip)]}
+        for (code, p, snip), n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "violations": entries}, f, indent=2,
+                  sort_keys=False)
+        f.write("\n")
+    return path

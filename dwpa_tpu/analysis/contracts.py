@@ -1,0 +1,303 @@
+"""Cross-layer contract checker: client wire fields vs server handlers
+vs sqlite schema.
+
+The dwpa protocol's work-unit and put_work schemas exist in THREE
+places that nothing ties together: the client reads fields off the
+work-unit JSON (client/main.py, client/protocol.py), the server builds
+that JSON from sqlite rows (server/core.py), and the columns those rows
+carry live in the DDL string (server/db.py SCHEMA).  A field renamed in
+one layer keeps every unit test green (each layer is tested against its
+own fixtures) and fails in production as a work unit the volunteer
+silently can't process — the exact species of drift ADVICE.md's round-5
+findings describe.
+
+This module diffs the three layers **statically** (pure AST + executing
+the DDL in an in-memory sqlite), so the check runs at test time with no
+server or client instantiated:
+
+- **DW201 work-unit drift** — a field the client reads off the work
+  unit that the server never emits.  Client-local annotations are
+  exempt by the underscore convention (``_ver``/``_nproc``/
+  ``_progress``/...), which this check also enforces: client-only keys
+  MUST start with ``_`` or they shadow future server fields.
+- **DW202 dict-entry drift** — keys the client reads off
+  ``work["dicts"][i]`` must be emitted by the server's per-dict
+  literal, and every key either side uses must be a column of the
+  ``dicts`` table.
+- **DW203 put_work drift** — fields the server's ``put_work`` handler
+  reads must be sent by the client (or injected by the WSGI layer,
+  e.g. ``ip``), and the candidate-entry keys must agree.
+- **DW204 SQL column drift** — column lists in INSERT statements across
+  ``server/*.py`` must exist in the SCHEMA's table definitions.
+"""
+
+import ast
+import os
+import re
+import sqlite3
+
+from .linter import Violation
+
+#: fields the WSGI layer injects into put_work payloads (server/api.py
+#: ``data.setdefault("ip", ...)``) — server reads of these are not drift
+WSGI_INJECTED = {"ip"}
+
+
+def _parse(root, rel):
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read())
+
+
+def _const_str(node):
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+def dict_read_keys(tree, varnames) -> dict:
+    """{key: first line} for every ``v["k"]`` / ``v.get("k", ...)`` /
+    ``v.pop("k", ...)`` where ``v`` is a Name in ``varnames``."""
+    out = {}
+    for node in ast.walk(tree):
+        key = None
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in varnames
+                and isinstance(node.ctx, ast.Load)):
+            key = _const_str(node.slice)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "pop", "setdefault")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in varnames and node.args):
+            key = _const_str(node.args[0])
+        if key is not None:
+            out.setdefault(key, node.lineno)
+    return out
+
+
+def dict_written_keys(tree, varname) -> set:
+    """Keys of dict literals assigned to ``varname`` plus later
+    ``varname["k"] = ...`` stores."""
+    keys = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id == varname
+                        and isinstance(node.value, ast.Dict)):
+                    for k in node.value.keys:
+                        s = _const_str(k)
+                        if s is not None:
+                            keys.add(s)
+                elif (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == varname):
+                    s = _const_str(t.slice)
+                    if s is not None:
+                        keys.add(s)
+    return keys
+
+
+def _dict_entry_vars(tree) -> set:
+    """Names bound by iterating/selecting over a work unit's "dicts"
+    list (``for d in work.get("dicts", [])``, comprehensions, and
+    ``entry = next((d for d in work...), ...)``) — the variables whose
+    string subscripts are dict-ENTRY keys."""
+    names = set()
+
+    def iter_mentions_dicts(it):
+        return any(_const_str(n) == "dicts" for n in ast.walk(it))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For) and iter_mentions_dicts(node.iter):
+            names |= {n.id for n in ast.walk(node.target)
+                      if isinstance(n, ast.Name)}
+        elif isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            for gen in node.generators:
+                if iter_mentions_dicts(gen.iter):
+                    names |= {n.id for n in ast.walk(gen.target)
+                              if isinstance(n, ast.Name)}
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            # entry = next((d for d in work.get("dicts", [])...), None)
+            if any(isinstance(a, ast.GeneratorExp)
+                   and any(iter_mentions_dicts(g.iter)
+                           for g in a.generators)
+                   for a in node.value.args):
+                names |= {n.id for t in node.targets for n in ast.walk(t)
+                          if isinstance(n, ast.Name)}
+    return names
+
+
+def _literal_keys_under(tree, outer_key) -> set:
+    """Keys of dict literals that appear inside the value expression of
+    ``outer_key`` in any dict literal (the server's per-dict entry
+    ``{"dhash": ..., "dpath": ...}`` nested under ``"dicts"``)."""
+    keys = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if _const_str(k) == outer_key:
+                for inner in ast.walk(v):
+                    if isinstance(inner, ast.Dict):
+                        for ik in inner.keys:
+                            s = _const_str(ik)
+                            if s is not None:
+                                keys.add(s)
+    return keys
+
+
+def _schema_columns(root) -> dict:
+    """{table: {column, ...}} by executing the SCHEMA DDL string from
+    server/db.py in an in-memory sqlite (no package import: the checker
+    must stay runnable against any tree, including test fixtures)."""
+    tree = _parse(root, "dwpa_tpu/server/db.py")
+    ddl = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SCHEMA":
+                    ddl = _const_str(node.value)
+    if ddl is None:
+        return {}
+    conn = sqlite3.connect(":memory:")
+    try:
+        conn.executescript(ddl)
+        tables = [r[0] for r in conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table'")]
+        return {t: {r[1] for r in conn.execute(f"PRAGMA table_info({t})")}
+                for t in tables}
+    finally:
+        conn.close()
+
+
+_INSERT_RE = re.compile(
+    r"INSERT\s+(?:OR\s+\w+\s+)?INTO\s+(\w+)\s*\(([^)]*)\)", re.I)
+
+
+def _insert_columns(tree):
+    """(table, [cols], line) for every INSERT with an explicit column
+    list in the module's string constants."""
+    out = []
+    for node in ast.walk(tree):
+        s = _const_str(node)
+        if s and "INSERT" in s.upper():
+            for m in _INSERT_RE.finditer(s):
+                cols = [c.strip() for c in m.group(2).split(",") if c.strip()]
+                out.append((m.group(1), cols, node.lineno))
+    return out
+
+
+def check_contracts(root: str) -> list:
+    """Run all cross-layer contract checks against the tree at ``root``.
+    Returns a list of linter.Violation (codes DW201-DW204)."""
+    out = []
+    client_main = _parse(root, "dwpa_tpu/client/main.py")
+    client_proto = _parse(root, "dwpa_tpu/client/protocol.py")
+    server_core = _parse(root, "dwpa_tpu/server/core.py")
+    server_api = _parse(root, "dwpa_tpu/server/api.py")
+
+    # ---- DW201: work-unit fields ------------------------------------
+    server_emits = dict_written_keys(server_core, "work")
+    client_reads = dict_read_keys(client_main, {"work"})
+    # protocol.py's required-field gate reads the same schema
+    for node in ast.walk(client_proto):
+        if isinstance(node, ast.For) and isinstance(node.iter, ast.Tuple):
+            fields = [_const_str(e) for e in node.iter.elts]
+            if fields and all(f is not None for f in fields):
+                for f in fields:
+                    client_reads.setdefault(f, node.lineno)
+    for key, line in sorted(client_reads.items()):
+        if key in server_emits:
+            continue
+        if key.startswith("_"):
+            continue  # client-local annotation by convention
+        out.append(Violation(
+            "DW201", "dwpa_tpu/client/main.py", line,
+            f"client reads work[{key!r}] but server/core.py never emits "
+            f"it (server emits: {sorted(server_emits)}); client-local "
+            "keys must start with '_'", f"work[{key!r}]"))
+
+    # ---- DW202: dict-entry fields vs dicts table --------------------
+    cols = _schema_columns(root)
+    dict_cols = cols.get("dicts", set())
+    server_entry_keys = _literal_keys_under(server_core, "dicts")
+    entry_vars = _dict_entry_vars(client_main)
+    client_entry_reads = dict_read_keys(client_main, entry_vars)
+    for key, line in sorted(client_entry_reads.items()):
+        if key not in server_entry_keys:
+            out.append(Violation(
+                "DW202", "dwpa_tpu/client/main.py", line,
+                f"client reads dict-entry key {key!r} but the server's "
+                f"per-dict literal only carries {sorted(server_entry_keys)}",
+                f"d[{key!r}]"))
+    for key in sorted(server_entry_keys):
+        if dict_cols and key not in dict_cols:
+            out.append(Violation(
+                "DW202", "dwpa_tpu/server/core.py", 0,
+                f"server emits dict-entry key {key!r} which is not a "
+                f"column of the dicts table ({sorted(dict_cols)})",
+                f'"{key}"'))
+
+    # ---- DW203: put_work payload ------------------------------------
+    client_sends = set()
+    for node in ast.walk(client_proto):
+        if isinstance(node, ast.FunctionDef) and node.name == "put_work":
+            for d in ast.walk(node):
+                if isinstance(d, ast.Dict):
+                    client_sends |= {_const_str(k) for k in d.keys
+                                     if _const_str(k)}
+    server_reads = {}
+    for node in ast.walk(server_core):
+        if isinstance(node, ast.FunctionDef) and node.name == "put_work":
+            server_reads = dict_read_keys(node, {"data"})
+    injected = set(dict_read_keys(server_api, {"data"})) | WSGI_INJECTED
+    for key, line in sorted(server_reads.items()):
+        if key not in client_sends and key not in injected:
+            out.append(Violation(
+                "DW203", "dwpa_tpu/server/core.py", line,
+                f"server put_work reads {key!r} but the client payload "
+                f"only carries {sorted(client_sends)} (WSGI injects "
+                f"{sorted(injected)})", f"data.get({key!r})"))
+    # candidate entry keys: client emits {"k","v"} literals, server
+    # reads pair.get(...)
+    cand_client = set()
+    for node in ast.walk(client_main):
+        if isinstance(node, ast.Dict):
+            keys = {_const_str(k) for k in node.keys}
+            if keys == {"k", "v"}:
+                cand_client |= keys
+    cand_server = set()
+    for node in ast.walk(server_core):
+        if isinstance(node, ast.FunctionDef) and node.name == "put_work":
+            cand_server = set(dict_read_keys(node, {"pair"}))
+    if cand_client:  # no literal found = no evidence, not drift
+        for key in sorted(cand_server - cand_client):
+            out.append(Violation(
+                "DW203", "dwpa_tpu/server/core.py", 0,
+                f"server reads candidate key {key!r} the client never "
+                f"sends (client sends {sorted(cand_client)})",
+                f"pair.get({key!r})"))
+
+    # ---- DW204: INSERT column lists vs schema -----------------------
+    for rel in ("dwpa_tpu/server/core.py", "dwpa_tpu/server/jobs.py",
+                "dwpa_tpu/server/api.py", "dwpa_tpu/server/db.py"):
+        if not os.path.exists(os.path.join(root, rel)):
+            continue
+        tree = _parse(root, rel)
+        for table, insert_cols, line in _insert_columns(tree):
+            known = cols.get(table)
+            if known is None:
+                out.append(Violation(
+                    "DW204", rel, line,
+                    f"INSERT INTO {table}: table not in SCHEMA "
+                    f"({sorted(cols)})", f"INSERT INTO {table}"))
+                continue
+            for c in insert_cols:
+                if c not in known:
+                    out.append(Violation(
+                        "DW204", rel, line,
+                        f"INSERT INTO {table}({c}): no such column "
+                        f"(schema has {sorted(known)})",
+                        f"INSERT INTO {table}({c})"))
+    return out

@@ -1,0 +1,560 @@
+"""AST linter for the repo's JAX contracts (the rules tier-1 runs).
+
+Every rule here encodes a hazard that has actually bitten this codebase
+or its reference lineage: silent XLA recompiles, Python control flow
+over tracers, host↔device syncs on the feed path, dtype promotions off
+the uint32 crypto lattice, and benchmark timings that stop the clock
+before the device finishes.  The type system sees none of these; they
+surface as throughput collapses or mid-cron crashes on real hardware.
+
+Rule codes (stable — referenced by baseline.json and the docs):
+
+- **DW101 traced-python-branch** — Python ``if``/``while``/ternary/
+  ``assert`` over a traced value, or a ``for`` loop iterating a tracer,
+  inside a function handed to a trace entry point (``jax.jit``,
+  ``shard_map``, ``vmap``, ``lax.scan``/``cond``/..., or the repo's
+  ``_shard`` wrapper).  Branching on a tracer either raises a
+  ConcretizationTypeError at runtime or — worse — silently bakes one
+  branch into the compiled program.
+- **DW102 uncached-jit** — ``jax.jit(...)`` whose compiled artifact
+  cannot be reused: immediately invoked (``jax.jit(f)(x)``), or created
+  inside a loop without being stored in a cache (subscript/attribute
+  target).  Each fresh jit object owns a fresh compile cache, so these
+  patterns recompile on every call — the exact failure the repo's
+  ``_STEP_CACHE`` idiom exists to prevent.
+- **DW103 off-lattice-dtype** — a float/int64/complex dtype reference
+  inside ``ops/``.  The crypto kernels are uint32-lane by design
+  (SHA/MD5/AES schedules); a float or 64-bit promotion silently
+  doubles register pressure or truncates on TPU (where x64 is off).
+- **DW104 host-sync-in-hot-path** — ``.item()``, dtype-less
+  ``np.asarray(...)``, or ``jax.device_get`` in the engine hot-path
+  modules (``parallel/step.py``, ``models/m22000.py``).  Each is a
+  device→host sync that serializes the pipeline; intentional ones
+  (the hits-gate, the rare-find decode) live in the baseline.
+- **DW105 unsynced-timed-section** — a ``time.perf_counter()`` span in
+  ``bench.py`` that launches device work but never forces completion
+  (``block_until_ready``, ``np.asarray``, or an engine ``crack*`` call,
+  which sync internally) before the clock stops.  On the tunnelled TPU
+  dispatch returns early, so such a span overstates throughput by
+  orders of magnitude (see bench.py's timing notes).
+
+The linter is repo-native, not general-purpose: rules are scoped to the
+paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
+``OPS_DIRS``) so the baseline stays small and every entry is a real,
+individually-accepted sync or compile.
+"""
+
+import ast
+import dataclasses
+import os
+
+#: files whose host↔device syncs DW104 polices (repo-relative, posix)
+HOT_PATH_FILES = ("dwpa_tpu/parallel/step.py", "dwpa_tpu/models/m22000.py")
+#: files whose timed sections DW105 polices
+BENCH_FILES = ("bench.py",)
+#: directories whose dtype lattice DW103 polices
+OPS_DIRS = ("dwpa_tpu/ops",)
+
+#: callables that put their function argument under a JAX trace
+TRACE_ENTRYPOINTS = {
+    "jit", "pjit", "vmap", "pmap", "shard_map", "scan", "fori_loop",
+    "while_loop", "cond", "switch", "checkpoint", "remat", "grad",
+    "value_and_grad", "custom_jvp", "custom_vjp",
+    # repo-specific wrappers (parallel/step.py)
+    "_shard",
+}
+
+#: dtypes allowed in ops/ — the uint32 crypto lattice plus the small
+#: integer types the packers use (int32 only as gather/index dtype)
+OPS_DTYPE_LATTICE = {
+    "uint8", "uint16", "uint32", "uint64", "int32", "bool_", "bool",
+}
+_BAD_DTYPES = {
+    "float16", "float32", "float64", "bfloat16", "float_",
+    "int64", "complex64", "complex128",
+}
+
+#: calls that force device completion (or are documented to sync
+#: internally, like the engine's crack loop via its hits gate)
+SYNC_MARKERS = {
+    "block_until_ready", "asarray", "item", "array",
+    "crack", "crack_batch", "crack_rules", "crack_mask",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    code: str     # DWxxx
+    path: str     # repo-relative posix path
+    line: int
+    detail: str   # human message
+    snippet: str  # stripped offending source line (baseline fingerprint)
+
+    def fingerprint(self) -> tuple:
+        """Baseline identity: survives line-number drift (code moving
+        around a file must not churn the baseline), dies with the code
+        itself (editing the offending line forces a baseline decision)."""
+        return (self.code, self.path, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.detail}"
+
+
+def _line(src_lines, node) -> str:
+    try:
+        return src_lines[node.lineno - 1].strip()
+    except IndexError:  # pragma: no cover - malformed lineno
+        return ""
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _is_np_attr(node, attr: str) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == attr
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("np", "numpy"))
+
+
+class _TaintScrubber(ast.NodeTransformer):
+    """Drop subtrees that are static at trace time (shape/dtype/len of a
+    tracer is a Python value), so taint checks don't flag branches on
+    them."""
+
+    _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+    def visit_Attribute(self, node):
+        if node.attr in self._STATIC_ATTRS:
+            return ast.copy_location(ast.Constant(value=0), node)
+        return self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id in ("len", "range"):
+            return ast.copy_location(ast.Constant(value=0), node)
+        return self.generic_visit(node)
+
+
+def _tainted_names(expr, tainted: set) -> set:
+    """Names from ``tainted`` that the expression's value can depend on,
+    ignoring trace-static subtrees (shapes, dtypes, len())."""
+    try:
+        scrubbed = _TaintScrubber().visit(ast.fix_missing_locations(
+            ast.parse(ast.unparse(expr), mode="eval")))
+    except (SyntaxError, ValueError):  # unparsable fragment: be conservative
+        scrubbed = expr
+    return _names_in(scrubbed) & tainted
+
+
+def _is_jaxlike_call(call: ast.Call) -> bool:
+    """Strict device-value producer (taint source): a call rooted at the
+    jnp/jax/lax namespaces."""
+    f = call.func
+    root = f
+    while isinstance(root, ast.Attribute):
+        root = root.value
+    return isinstance(root, ast.Name) and root.id in ("jnp", "jax", "lax")
+
+
+def _is_devicework_call(call: ast.Call) -> bool:
+    """Loose device-work launcher (bench timed-section heuristic): jax
+    namespaces, engine crack* methods, or kernel-named helpers."""
+    if _is_jaxlike_call(call):
+        return True
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr.startswith("crack"):
+        return True
+    if isinstance(f, ast.Name) and ("pallas" in f.id or "pbkdf2" in f.id
+                                    or f.id.startswith("crack")):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# traced-function discovery + DW101/DW104-in-trace
+# ---------------------------------------------------------------------------
+
+
+def _static_params(call) -> tuple:
+    """(names, nums) declared static on a jit-style call: taint must not
+    cover them — branching on a static arg is the supported idiom."""
+    names, nums = set(), set()
+    if not isinstance(call, ast.Call):
+        return names, nums
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = (kw.value.elts if isinstance(kw.value, ast.Tuple)
+                    else [kw.value])
+            names |= {v.value for v in vals
+                      if isinstance(v, ast.Constant)
+                      and isinstance(v.value, str)}
+        elif kw.arg == "static_argnums":
+            vals = (kw.value.elts if isinstance(kw.value, ast.Tuple)
+                    else [kw.value])
+            nums |= {v.value for v in vals
+                     if isinstance(v, ast.Constant)
+                     and isinstance(v.value, int)}
+    return names, nums
+
+
+def _traced_functions(tree: ast.Module):
+    """Yield (funcdef, how, static_names, static_nums) for every function
+    the module demonstrably puts under a JAX trace: decorated with a
+    trace entry point, or passed (by name or as an inline lambda) to
+    one.  static_* carry the entry's static_argnames/argnums so the
+    taint analysis exempts those parameters."""
+    by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = (target.attr if isinstance(target, ast.Attribute)
+                        else getattr(target, "id", ""))
+                if name in TRACE_ENTRYPOINTS and id(node) not in seen:
+                    seen.add(id(node))
+                    snames, snums = _static_params(
+                        dec if isinstance(dec, ast.Call) else None)
+                    yield node, f"@{name}", snames, snums
+        elif isinstance(node, ast.Call):
+            entry = _call_name(node)
+            if entry not in TRACE_ENTRYPOINTS:
+                continue
+            snames, snums = _static_params(node)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda) and id(arg) not in seen:
+                    seen.add(id(arg))
+                    yield arg, f"lambda->{entry}", snames, snums
+                elif (isinstance(arg, ast.Name) and arg.id in by_name
+                      and id(by_name[arg.id]) not in seen):
+                    seen.add(id(by_name[arg.id]))
+                    yield by_name[arg.id], f"{arg.id}->{entry}", snames, snums
+
+
+def _is_static_test(test) -> bool:
+    """``x is None`` / ``x is not None`` is host-level control flow even
+    when x may hold a tracer (a tracer is never None), so it is decided
+    at trace time — the accumulate-or-init idiom, not a tracer branch."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops)
+            and any(isinstance(c, ast.Constant) and c.value is None
+                    for c in [test.left] + test.comparators))
+
+
+def _check_traced_function(fn, how, static_names, static_nums, path,
+                           src_lines, out):
+    """DW101 inside one traced function: taint params + jnp/lax results,
+    flag Python control flow whose condition depends on the taint."""
+    args = fn.args
+    positional = args.posonlyargs + args.args
+    static = set(static_names)
+    static |= {positional[i].arg for i in static_nums
+               if i < len(positional)}
+    tainted = {a.arg for a in (
+        positional + args.kwonlyargs
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ) if a.arg != "self" and a.arg not in static}
+
+    body = fn.body if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+        else [ast.Expr(value=fn.body)]
+
+    for node in [n for stmt in body for n in ast.walk(stmt)]:
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = node.value
+            if value is None:
+                continue
+            dep = bool(_tainted_names(value, tainted)) or any(
+                _is_jaxlike_call(c)
+                for c in ast.walk(value) if isinstance(c, ast.Call))
+            if dep:
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    # a subscript store taints the CONTAINER, never the
+                    # index expression (byte_cols[p] = ... must not
+                    # taint p)
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    for n in ast.walk(base):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        elif isinstance(node, (ast.If, ast.While)):
+            if _is_static_test(node.test):
+                continue
+            hits = _tainted_names(node.test, tainted)
+            if hits:
+                out.append(Violation(
+                    "DW101", path, node.lineno,
+                    f"Python {'if' if isinstance(node, ast.If) else 'while'} "
+                    f"over traced value(s) {sorted(hits)} inside "
+                    f"traced function ({how}) — branch on a tracer",
+                    _line(src_lines, node)))
+        elif isinstance(node, ast.IfExp):
+            if _is_static_test(node.test):
+                continue
+            hits = _tainted_names(node.test, tainted)
+            if hits:
+                out.append(Violation(
+                    "DW101", path, node.lineno,
+                    f"ternary over traced value(s) {sorted(hits)} inside "
+                    f"traced function ({how})", _line(src_lines, node)))
+        elif isinstance(node, ast.Assert):
+            hits = _tainted_names(node.test, tainted)
+            if hits:
+                out.append(Violation(
+                    "DW101", path, node.lineno,
+                    f"assert over traced value(s) {sorted(hits)} inside "
+                    f"traced function ({how})", _line(src_lines, node)))
+        elif isinstance(node, ast.For):
+            # iterating the tracer ITSELF (bare name/attribute) unrolls
+            # per element; zip/enumerate over python containers of
+            # tracers is static and fine.
+            it = node.iter
+            if isinstance(it, (ast.Name, ast.Attribute)):
+                hits = _names_in(it) & tainted
+                if hits:
+                    out.append(Violation(
+                        "DW101", path, node.lineno,
+                        f"for loop iterates traced value {sorted(hits)} "
+                        f"inside traced function ({how})",
+                        _line(src_lines, node)))
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in ("int", "float", "bool") and node.args:
+                hits = _tainted_names(node.args[0], tainted)
+                if hits:
+                    out.append(Violation(
+                        "DW104", path, node.lineno,
+                        f"{name}() concretizes traced value(s) "
+                        f"{sorted(hits)} inside traced function ({how}) — "
+                        "host sync / ConcretizationTypeError",
+                        _line(src_lines, node)))
+
+
+# ---------------------------------------------------------------------------
+# DW102 uncached jit
+# ---------------------------------------------------------------------------
+
+
+def _is_jit_ref(node) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("jit", "pjit")
+    if isinstance(node, ast.Name):
+        return node.id in ("jit", "pjit")
+    return False
+
+
+def _check_uncached_jit(tree, path, src_lines, out):
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+            self.cached_jits = set()  # jit Call nodes stored to a cache
+
+        def _mark_cached(self, value):
+            for n in ast.walk(value):
+                if isinstance(n, ast.Call) and _is_jit_ref(n.func):
+                    self.cached_jits.add(id(n))
+
+        def visit_Assign(self, node):
+            if any(isinstance(t, (ast.Subscript, ast.Attribute))
+                   for t in node.targets):
+                self._mark_cached(node.value)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_While = visit_For
+
+        def visit_Call(self, node):
+            # jax.jit(f)(x): the jit object dies with the statement, so
+            # every execution is a fresh trace + compile
+            if (isinstance(node.func, ast.Call)
+                    and _is_jit_ref(node.func.func)):
+                out.append(Violation(
+                    "DW102", path, node.lineno,
+                    "jit result invoked immediately — fresh compile cache "
+                    "per call (store the jitted fn once and reuse it)",
+                    _line(src_lines, node)))
+            elif (_is_jit_ref(node.func) and self.loop_depth > 0
+                    and id(node) not in self.cached_jits):
+                out.append(Violation(
+                    "DW102", path, node.lineno,
+                    "jax.jit(...) created inside a loop without a cache "
+                    "(subscript/attribute store) — recompiles every "
+                    "iteration", _line(src_lines, node)))
+            self.generic_visit(node)
+
+    V().visit(tree)
+
+
+# ---------------------------------------------------------------------------
+# DW103 ops/ dtype lattice
+# ---------------------------------------------------------------------------
+
+
+def _check_ops_dtypes(tree, path, src_lines, out):
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute) and node.attr in _BAD_DTYPES
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("np", "numpy", "jnp")):
+            out.append(Violation(
+                "DW103", path, node.lineno,
+                f"dtype {node.value.id}.{node.attr} is off the uint32 "
+                f"crypto lattice (allowed: {sorted(OPS_DTYPE_LATTICE)})",
+                _line(src_lines, node)))
+        elif (isinstance(node, ast.Call) and _call_name(node) == "astype"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and str(node.args[0].value) in _BAD_DTYPES):
+            out.append(Violation(
+                "DW103", path, node.lineno,
+                f"astype({node.args[0].value!r}) is off the uint32 crypto "
+                "lattice", _line(src_lines, node)))
+
+
+# ---------------------------------------------------------------------------
+# DW104 host syncs in hot-path modules
+# ---------------------------------------------------------------------------
+
+
+def _check_hot_path_syncs(tree, path, src_lines, out):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+            out.append(Violation(
+                "DW104", path, node.lineno,
+                ".item() is a device->host sync on the hot path",
+                _line(src_lines, node)))
+        elif _is_np_attr(f, "asarray") or _is_np_attr(f, "array"):
+            # dtype= marks the host-packing idiom (pure host data);
+            # a dtype-less np.asarray of a device value is THE implicit
+            # transfer+sync this rule exists for.
+            if not any(kw.arg == "dtype" for kw in node.keywords):
+                out.append(Violation(
+                    "DW104", path, node.lineno,
+                    f"np.{f.attr}(...) without dtype= in a hot-path module "
+                    "— implicit device->host sync if fed a device value",
+                    _line(src_lines, node)))
+        elif (isinstance(f, ast.Attribute) and f.attr == "device_get"):
+            out.append(Violation(
+                "DW104", path, node.lineno,
+                "jax.device_get is a device->host sync on the hot path",
+                _line(src_lines, node)))
+
+
+# ---------------------------------------------------------------------------
+# DW105 bench timed sections
+# ---------------------------------------------------------------------------
+
+
+def _is_clock_call(node) -> bool:
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("perf_counter", "monotonic", "time")
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _check_timed_sections(tree, path, src_lines, out):
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stmts = fn.body
+        for i, stmt in enumerate(stmts):
+            if not (isinstance(stmt, ast.Assign) and _is_clock_call(stmt.value)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            t_name = stmt.targets[0].id
+            # find the stop: first later statement computing clock() - t_name
+            stop = None
+            for j in range(i + 1, len(stmts)):
+                for n in ast.walk(stmts[j]):
+                    if (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub)
+                            and _is_clock_call(n.left)
+                            and isinstance(n.right, ast.Name)
+                            and n.right.id == t_name):
+                        stop = j
+                        break
+                if stop is not None:
+                    break
+            if stop is None:
+                continue
+            region = stmts[i + 1:stop]
+            calls = [n for s in region for n in ast.walk(s)
+                     if isinstance(n, ast.Call)]
+            launches = any(_is_devicework_call(c) for c in calls)
+            synced = any(_call_name(c) in SYNC_MARKERS for c in calls)
+            if launches and not synced:
+                out.append(Violation(
+                    "DW105", path, stmt.lineno,
+                    f"timed section '{t_name}' in {fn.name}() launches "
+                    "device work but never forces completion "
+                    "(block_until_ready / np.asarray / engine crack*) "
+                    "before the clock stops", _line(src_lines, stmt)))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def lint_source(src: str, path: str) -> list:
+    """Lint one file's source; ``path`` is the repo-relative posix path
+    (rule scoping keys off it).  Returns a list of Violations."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Violation("DW100", path, e.lineno or 0,
+                          f"syntax error: {e.msg}", "")]
+    src_lines = src.splitlines()
+    out = []
+    for fn, how, snames, snums in _traced_functions(tree):
+        _check_traced_function(fn, how, snames, snums, path, src_lines, out)
+    _check_uncached_jit(tree, path, src_lines, out)
+    if path.startswith(tuple(d + "/" for d in OPS_DIRS)):
+        _check_ops_dtypes(tree, path, src_lines, out)
+    if path in HOT_PATH_FILES:
+        _check_hot_path_syncs(tree, path, src_lines, out)
+    if path in BENCH_FILES:
+        _check_timed_sections(tree, path, src_lines, out)
+    return out
+
+
+def lint_file(full_path: str, root: str) -> list:
+    rel = os.path.relpath(full_path, root).replace(os.sep, "/")
+    with open(full_path, encoding="utf-8") as f:
+        return lint_source(f.read(), rel)
+
+
+def lint_tree(root: str) -> list:
+    """Lint every tracked .py file under ``root`` (skipping caches,
+    hidden dirs and the test tree — tests intentionally seed
+    violations)."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not d.startswith(".") and d not in (
+                "__pycache__", "tests", "build", "dist"))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                out.append(lint_file(os.path.join(dirpath, name), root))
+    return [v for vs in out for v in vs]

@@ -1,0 +1,29 @@
+"""Pytest integration for the recompilation sentinel.
+
+Import the fixture from a conftest to make it available suite-wide::
+
+    from dwpa_tpu.analysis.pytest_plugin import recompile_sentinel  # noqa
+
+Usage in a test — guard a steady-state sweep so a shape/static-arg leak
+that recompiles per batch fails the test::
+
+    def test_autotune_sweep_stays_compiled(recompile_sentinel):
+        engine.crack_batch(words)            # warmup compile, unguarded
+        with recompile_sentinel(allowed=0, label="autotune sweep"):
+            for batch in sweep:
+                engine.crack_batch(batch)    # RecompilationError on miss
+
+Kept separate from :mod:`.recompile` so the analysis package never
+imports pytest outside test runs.
+"""
+
+import pytest
+
+from .recompile import no_recompiles
+
+
+@pytest.fixture
+def recompile_sentinel():
+    """Factory fixture: ``recompile_sentinel(allowed=0, label="")``
+    returns the fail-on-exit context manager (see recompile.no_recompiles)."""
+    return no_recompiles

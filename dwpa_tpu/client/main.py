@@ -88,6 +88,33 @@ def _allgather_strs(s: str, width: int = 256):
     return [bytes(r).rstrip(b"\0").decode("utf-8", "replace") for r in rows]
 
 
+def shard_word_blocks(words, nproc: int, pid: int, batch_size: int,
+                      pad_word: bytes = b""):
+    """Block-slice a GLOBAL word stream into this host's 1/nproc shard,
+    yielding ``(host_words, global_count)`` per block.
+
+    The no-rules pass-2 analog of crack_rules' internal sharding (and of
+    the host tail's ``submit_host`` slicing in m22000.crack_rules): every
+    host consumes the identical global stream, takes its contiguous
+    ``blk = ceil(len(block)/nproc)`` slice of each ``batch_size * nproc``
+    block, and pads short slices with an invalid word so EVERY host feeds
+    the engine the same number of same-sized batches — the SPMD-lockstep
+    contract ``M22000Engine.crack`` requires (an unpadded empty tail
+    slice would desync the shard_map collectives).  ``global_count`` is
+    the number of real global candidates the block covers, so resume
+    checkpoints keep counting stream positions, not local shard rows.
+    """
+    words = iter(words)
+    while True:
+        block = list(itertools.islice(words, batch_size * nproc))
+        if not block:
+            return
+        blk = min(batch_size, -(-len(block) // nproc))
+        mine = block[pid * blk:(pid + 1) * blk]
+        mine += [pad_word] * (blk - len(mine))
+        yield mine, len(block)
+
+
 def version_tuple(v: str):
     """Order dotted versions with optional alpha suffixes, matching the
     reference's numeric+alpha compare (help_crack.py:128-156)."""
@@ -259,14 +286,17 @@ class TpuCrackClient:
         # batch, and a crash during the write must never corrupt the only
         # copy (a truncated snapshot would be discarded on restart and the
         # whole work unit lost until the server's lease reap).
-        # The version + mesh-topology stamps gate replay: skip-by-count
-        # is only sound against the exact stream order this client build
-        # generates, and both an upgrade and a single-/multi-process
+        # The version + mesh-topology + batch-size stamps gate replay:
+        # skip-by-count is only sound against the exact stream order this
+        # client build generates.  An upgrade and a single-/multi-process
         # topology change reorder pass 2 (device crack_rules order vs
-        # host apply_rules order) — a mismatched resume could silently
-        # skip candidates that were never tried.
+        # host apply_rules order), and the batch size changes crack_rules'
+        # chunk boundaries (base-batch major order means a different -b
+        # interleaves (word, rule) pairs differently) — a mismatched
+        # resume could silently skip candidates that were never tried.
         work["_ver"] = __version__
         work["_nproc"] = jax.process_count()
+        work["_batch"] = self.cfg.batch_size
         tmp = self.resume_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(work, f)
@@ -284,7 +314,8 @@ class TpuCrackClient:
                 work = json.load(f)
             if ("hkey" in work and "hashes" in work and "dicts" in work
                     and work.get("_ver") == __version__
-                    and work.get("_nproc") == jax.process_count()):
+                    and work.get("_nproc") == jax.process_count()
+                    and work.get("_batch") == self.cfg.batch_size):
                 return work
         except (ValueError, OSError):
             pass
@@ -566,6 +597,32 @@ class TpuCrackClient:
             # 1/nproc row slice and decodes finds from the replicated
             # bitmask, so no host ever feeds expanded candidates.
             engine.crack_rules(words, rules, on_batch=on_batch, skip=skip2)
+        elif jax.process_count() > 1:
+            # No-rules pass 2 shards too (it used to run replicated —
+            # nproc× redundant PBKDF2 on the bulk of the unit): each
+            # host feeds its block slice of the global stream, padded so
+            # batch counts stay in SPMD lockstep, and the checkpoint
+            # counter keeps counting GLOBAL stream positions (the resume
+            # skip below is applied to the global stream, so the two
+            # must agree or a resume would skip untried candidates).
+            for _ in itertools.islice(words, skip2):
+                pass
+            blocks = shard_word_blocks(words, jax.process_count(),
+                                       jax.process_index(),
+                                       self.cfg.batch_size)
+            global_counts = []
+
+            def local_words():
+                for mine, gcount in blocks:
+                    global_counts.append(gcount)
+                    yield from mine
+
+            def on_block(consumed, new_founds):
+                # one engine batch per block, in stream order — report
+                # the block's global coverage, not the local shard rows
+                on_batch(global_counts.pop(0), new_founds)
+
+            engine.crack(local_words(), on_batch=on_block)
         else:
             for _ in itertools.islice(words, skip2):
                 pass
@@ -636,7 +693,10 @@ class TpuCrackClient:
         Pass 1 runs replicated — every host feeds the identical targeted
         stream as its local shard, costing nproc× redundant PBKDF2 on
         the (small) pass-1 candidate set; pass 2, where the volume is,
-        shards for real (crack_rules' global-stream contract).
+        shards for real: with rules via crack_rules' global-stream
+        contract, without rules via ``shard_word_blocks`` (each host
+        feeds its padded 1/nproc block slice of the global dict stream,
+        so the slice covers the unit once, not nproc times).
         """
         multiproc = jax.process_count() > 1
         pid = jax.process_index()

@@ -21,7 +21,8 @@ Pack format — JSON ``{"families": [entry, ...]}``; every entry has:
 - ``kind`` + kind-specific fields:
 
 ``fixed``      — ``{"keys": ["...", ...]}``: constant factory keys
-  (the Andared-style single-key networks).
+  (the Andared-style single-key networks); every key must be a
+  non-empty string (validated at load).
 ``mac_map``    — ``{"slices": [[s, e], ...], "case": "lower"|"upper",
   "prefix": "", "suffix": "", "offsets": [0, 1, -1]}``: the key is a
   concatenation of substrings of the 12-char MAC hex (Megared/Conn/
@@ -41,7 +42,9 @@ Pack format — JSON ``{"families": [entry, ...]}``; every entry has:
   ...]}, "magic_hex": .., "charset": .., "take": ..}``: the Alice-AGPF
   serial-table scheme (gen/vendors.alice_agpf_keys) with per-pack
   magic/charset overrides — covers the AGPF siblings that reuse the
-  structure with different constants.
+  structure with different constants.  Its ``ssid_re`` must carry
+  EXACTLY one mandatory capture group (the serial digits fed to the
+  scheme); optional or alternated groups are rejected at load.
 
 Every candidate is still verified against the real handshake by keygen
 precompute (server/jobs.py) before acceptance, so a bad pack costs
@@ -52,7 +55,39 @@ import hashlib
 import json
 import re
 
+try:  # the sre parse tree moved in 3.11+; same structure either way
+    from re import _constants as sre_constants, _parser as sre_parse
+except ImportError:  # pragma: no cover - 3.10 spelling
+    import sre_constants
+    import sre_parse
+
 _HASHES = {"md5": hashlib.md5, "sha1": hashlib.sha1, "sha256": hashlib.sha256}
+
+
+def _mandatory_group_nums(parsed) -> set:
+    """Group numbers that participate in EVERY match of the parsed
+    pattern: not under a ``{0,n}``/``?``/``*`` repeat and present in all
+    branches of every alternation.  A group outside this set can be
+    ``None`` on a successful match — the ``AttributeError`` landmine
+    ``serial_hash`` validation exists to disarm."""
+    out = set()
+    for op, av in parsed:
+        if op is sre_constants.SUBPATTERN:
+            group, _af, _df, sub = av
+            if group:
+                out.add(group)
+            out |= _mandatory_group_nums(sub)
+        elif op in (sre_constants.MAX_REPEAT, sre_constants.MIN_REPEAT):
+            lo, _hi, sub = av
+            if lo >= 1:
+                out |= _mandatory_group_nums(sub)
+        elif op is sre_constants.BRANCH:
+            sets = [_mandatory_group_nums(b) for b in av[1]]
+            common = sets[0]
+            for s in sets[1:]:
+                common = common & s
+            out |= common
+    return out
 
 
 def _mac_neighbourhood(bssid: bytes, offsets):
@@ -118,7 +153,28 @@ class _Family:
             for s, t in entry["slices"]:
                 if not 0 <= int(s) <= int(t) <= 12:
                     raise ValueError(f"mac slice [{s}, {t}] out of range")
+        elif self.kind == "fixed":
+            # mirror hash_map's eager posture: a non-string (JSON number,
+            # null, nested list) or empty key would TypeError on .encode()
+            # or emit an empty candidate on the first matching net mid-cron
+            if not isinstance(entry["keys"], (list, tuple)) or not entry["keys"]:
+                raise ValueError("fixed 'keys' must be a non-empty list")
+            for k in entry["keys"]:
+                if not isinstance(k, str) or not k:
+                    raise ValueError(
+                        f"fixed key {k!r} must be a non-empty string")
         elif self.kind == "serial_hash":
+            # __call__ feeds m.group(1) to the serial scheme, so the
+            # regex must GUARANTEE that group exists on every match — an
+            # optional/alternated group would return None and raise
+            # AttributeError on .decode() mid-cron instead of at load
+            if (self.ssid_re.groups != 1
+                    or 1 not in _mandatory_group_nums(
+                        sre_parse.parse(entry["ssid_re"]))):
+                raise ValueError(
+                    "serial_hash ssid_re must have exactly one mandatory "
+                    f"capture group (the serial digits): "
+                    f"{entry['ssid_re']!r}")
             if "magic_hex" in entry:
                 bytes.fromhex(entry["magic_hex"])
             for series in entry["series"].values():
@@ -172,9 +228,9 @@ class _Family:
         elif self.kind == "serial_hash":
             from .vendors import alice_agpf_keys
 
-            # series key = first capture group if present, else the
-            # leading two digits of the matched SSID number
-            digits = (m.group(1) if m.groups() else m.group(0)).decode()
+            # the single mandatory capture group (validated at load)
+            # carries the serial digits
+            digits = m.group(1).decode()
             magic = bytes.fromhex(e["magic_hex"]) if "magic_hex" in e else None
             for key in alice_agpf_keys(
                 digits, bssid, configs=e["series"], magic=magic,

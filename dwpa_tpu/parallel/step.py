@@ -37,19 +37,32 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import m22000 as m
 from .mesh import DP_AXIS
 
+# jax >= 0.6 exposes shard_map at the top level with the replication check
+# spelled ``check_vma``; on the 0.4/0.5 line it lives in jax.experimental
+# and the same knob is ``check_rep``.  Resolve once at import so every
+# step builder below is version-agnostic.
+if hasattr(jax, "shard_map"):
+    _shard_map, _CHECK_KW = jax.shard_map, "check_vma"
+else:  # pragma: no cover - exercised only on older jax installs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
 #: (mesh, kind, *static) -> jitted sharded step, shared process-wide.
 _STEP_CACHE = {}
 
 
 def _shard(mesh, fn, in_specs, out_specs):
-    # check_vma=False: the rolled compressions seed their fori_loop carries
-    # from unsharded per-net constants, which fails JAX's varying-manual-axes
-    # check even though every carry is elementwise over the dp-sharded batch
-    # (each device runs the identical replicated constants against its own
-    # candidate shard, so replication is trivially consistent).
+    # check_vma/check_rep=False: the rolled compressions seed their fori_loop
+    # carries from unsharded per-net constants, which fails JAX's
+    # varying-manual-axes check even though every carry is elementwise over
+    # the dp-sharded batch (each device runs the identical replicated
+    # constants against its own candidate shard, so replication is trivially
+    # consistent).
     return jax.jit(
-        jax.shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            **{_CHECK_KW: False}
         )
     )
 

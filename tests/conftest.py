@@ -32,3 +32,8 @@ from dwpa_tpu.utils.compcache import enable_compilation_cache
 enable_compilation_cache(
     os.path.join(os.path.dirname(__file__), "..", ".pytest_xla_cache")
 )
+
+# Recompilation sentinel (dwpa_tpu.analysis): guards steady-state sweeps
+# against per-batch XLA recompiles.  Imported AFTER the platform setup
+# above — the plugin pulls in jax.
+from dwpa_tpu.analysis.pytest_plugin import recompile_sentinel  # noqa: E402,F401

@@ -1,0 +1,629 @@
+"""dwpa_tpu.analysis: lint rules on seeded violations, the recompilation
+sentinel, the cross-layer contract checker, and the full-tree baseline
+run (the tier-1 wiring of ``python -m dwpa_tpu.analysis``).
+
+Every lint rule is proven BOTH ways: a seeded violation the pass
+demonstrably catches, and the nearest compliant idiom it must stay
+silent on — a linter that cries wolf gets baselined into uselessness.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dwpa_tpu.analysis import (
+    RecompilationError, apply_baseline, check_contracts, collect_violations,
+    lint_source, load_baseline, no_recompiles, repo_root, run_analysis,
+    watch_compiles, write_baseline,
+)
+
+OPS_PATH = "dwpa_tpu/ops/seeded.py"
+HOT_PATH = "dwpa_tpu/models/m22000.py"
+
+
+def codes(violations):
+    return [v.code for v in violations]
+
+
+def lint(src, path="dwpa_tpu/somewhere.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+# ---------------------------------------------------------------------------
+# DW101: python control flow over tracers
+# ---------------------------------------------------------------------------
+
+
+def test_dw101_branch_on_jitted_param():
+    vs = lint("""
+        import jax
+
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW101"]
+    assert "branch on a tracer" in vs[0].detail
+
+
+def test_dw101_loop_and_while_and_ternary():
+    vs = lint("""
+        import jax
+
+        def step(x, y):
+            for v in x:
+                y = y + v
+            while y:
+                y = y - 1
+            return y if x else y
+
+        run = jax.jit(step)
+    """)
+    assert sorted(codes(vs)) == ["DW101", "DW101", "DW101"]
+
+
+def test_dw101_static_argnames_exempt():
+    vs = lint("""
+        import jax
+
+        def step(x, mode):
+            if mode:
+                return x * 2
+            return x
+
+        run = jax.jit(step, static_argnames=("mode",))
+    """)
+    assert vs == []
+
+
+def test_dw101_static_argnums_exempt():
+    vs = lint("""
+        import jax
+
+        def step(x, mode):
+            if mode:
+                return x * 2
+            return x
+
+        run = jax.jit(step, static_argnums=(1,))
+    """)
+    assert vs == []
+
+
+def test_dw101_shape_len_and_is_none_are_static():
+    """Branching on .shape/len()/is-None is decided at trace time —
+    the repo's pad/accumulate idioms must stay clean."""
+    vs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def step(x, acc):
+            if x.shape[0] % 32:
+                x = jnp.pad(x, (0, 32 - x.shape[0] % 32))
+            acc = x if acc is None else acc + x
+            return acc
+
+        run = jax.jit(step)
+    """)
+    assert vs == []
+
+
+def test_dw101_taint_through_assignment_and_jnp_calls():
+    vs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            total = jnp.sum(x)
+            if total > 3:
+                return x
+            return -x
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW101"]
+
+
+def test_dw101_lambda_passed_to_entrypoint():
+    vs = lint("""
+        import jax
+
+        out = jax.vmap(lambda row: row if row else -row)(rows)
+    """)
+    assert codes(vs) == ["DW101"]
+
+
+def test_dw101_repo_shard_wrapper_counts_as_entrypoint():
+    vs = lint("""
+        def local(batch):
+            if batch:
+                return batch
+            return -batch
+
+        step = _shard(mesh, local, in_specs, out_specs)
+    """)
+    assert codes(vs) == ["DW101"]
+
+
+def test_dw104_concretizing_call_in_trace():
+    vs = lint("""
+        import jax
+
+        def step(x):
+            return float(x)
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW104"]
+
+
+# ---------------------------------------------------------------------------
+# DW102: uncached jit
+# ---------------------------------------------------------------------------
+
+
+def test_dw102_immediate_invoke():
+    vs = lint("""
+        import jax
+
+        def crack(x):
+            return jax.jit(lambda a: a * 2)(x)
+    """)
+    assert "DW102" in codes(vs)
+    assert "fresh compile cache" in vs[0].detail
+
+
+def test_dw102_jit_in_loop_uncached():
+    vs = lint("""
+        import jax
+
+        def sweep(batches):
+            outs = []
+            for b in batches:
+                f = jax.jit(kernel)
+                outs.append(f(b))
+            return outs
+    """)
+    assert "DW102" in codes(vs)
+
+
+def test_dw102_cache_store_exempt():
+    """The repo's _STEP_CACHE idiom: jit stored under a subscript (or
+    attribute) key is a cache, not a leak."""
+    vs = lint("""
+        import jax
+
+        _CACHE = {}
+
+        def sweep(batches):
+            for b in batches:
+                if b.key not in _CACHE:
+                    _CACHE[b.key] = jax.jit(kernel)
+                _CACHE[b.key](b)
+    """)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# DW103: ops/ dtype lattice
+# ---------------------------------------------------------------------------
+
+
+def test_dw103_float_dtype_in_ops():
+    src = """
+        import jax.numpy as jnp
+
+        def mix(x):
+            return x.astype(jnp.float32)
+    """
+    assert codes(lint(src, OPS_PATH)) == ["DW103"]
+    # same source outside ops/ is out of scope
+    assert lint(src, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw103_int64_and_astype_string():
+    vs = lint("""
+        import numpy as np
+
+        def widen(x):
+            y = np.int64(3)
+            return x.astype("float64")
+    """, OPS_PATH)
+    assert sorted(codes(vs)) == ["DW103", "DW103"]
+
+
+def test_dw103_lattice_dtypes_clean():
+    vs = lint("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def ok(x):
+            a = jnp.uint32(7)
+            b = np.uint8(1)
+            return x.astype(jnp.int32) + a + b
+    """, OPS_PATH)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# DW104: host syncs in hot-path modules
+# ---------------------------------------------------------------------------
+
+
+def test_dw104_item_and_bare_asarray_in_hot_path():
+    src = """
+        import numpy as np
+
+        def gate(hits_dev, found_dev):
+            if int(np.asarray(hits_dev).sum()) == 0:
+                return None
+            return hits_dev.item()
+    """
+    vs = lint(src, HOT_PATH)
+    assert sorted(codes(vs)) == ["DW104", "DW104"]
+    # out of the hot-path scope: silent
+    assert lint(src, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw104_dtype_kwarg_marks_host_packing():
+    vs = lint("""
+        import numpy as np
+
+        def pack(words):
+            return np.asarray(words, dtype=np.uint32)
+    """, HOT_PATH)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# DW105: bench timed sections
+# ---------------------------------------------------------------------------
+
+
+def test_dw105_unsynced_timed_section():
+    vs = lint("""
+        import time
+        import jax.numpy as jnp
+
+        def bench(x):
+            t0 = time.perf_counter()
+            y = jnp.dot(x, x)
+            dt = time.perf_counter() - t0
+            return y, dt
+    """, "bench.py")
+    assert codes(vs) == ["DW105"]
+    assert "never forces completion" in vs[0].detail
+
+
+def test_dw105_synced_sections_clean():
+    vs = lint("""
+        import time
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def bench_blocked(x):
+            t0 = time.perf_counter()
+            y = jax.block_until_ready(jnp.dot(x, x))
+            return time.perf_counter() - t0
+
+        def bench_fetched(x):
+            t0 = time.perf_counter()
+            y = np.asarray(jnp.dot(x, x))
+            return time.perf_counter() - t0
+
+        def bench_engine(engine, words):
+            t0 = time.perf_counter()
+            engine.crack(words)
+            return time.perf_counter() - t0
+
+        def bench_hostwork(words):
+            t0 = time.perf_counter()
+            n = sum(len(w) for w in words)
+            return time.perf_counter() - t0
+    """, "bench.py")
+    assert vs == []
+
+
+def test_dw105_scoped_to_bench_files():
+    vs = lint("""
+        import time
+        import jax.numpy as jnp
+
+        def helper(x):
+            t0 = time.perf_counter()
+            y = jnp.dot(x, x)
+            return y, time.perf_counter() - t0
+    """, "dwpa_tpu/utils/bytesops.py")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# recompilation sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_watch_compiles_counts_misses_and_hits():
+    f = jax.jit(lambda a: a * 2 + 1)
+    x = jnp.arange(16.0)  # built OUTSIDE the guard: its iota is not f's
+    with watch_compiles() as warm:
+        f(x)
+    assert warm.count == 1 and warm.names
+
+    with watch_compiles() as steady:
+        for _ in range(3):
+            f(x)  # same shape: jit cache hits
+    assert steady.count == 0
+
+
+def test_no_recompiles_catches_per_batch_compile():
+    """The seeded failure mode: a fresh jit per batch (or a shape leak)
+    recompiles every iteration of a sweep."""
+    with pytest.raises(RecompilationError, match="recompiling the hot"):
+        with no_recompiles(label="seeded sweep"):
+            for n in (4, 5, 6):
+                jax.jit(lambda a: a + 1)(jnp.arange(float(n)))
+
+
+def test_no_recompiles_budget_allows_warmup():
+    f = jax.jit(lambda a: a - 3)
+    x = jnp.arange(32.0)
+    with no_recompiles(allowed=1, label="first-shape budget"):
+        f(x)                      # one intentional compile
+        f(x)                      # steady
+
+
+def test_recompile_sentinel_fixture(recompile_sentinel):
+    f = jax.jit(lambda a: a * a)
+    x = jnp.arange(8.0)
+    f(x)  # warmup outside the guard
+    with recompile_sentinel(allowed=0, label="fixture sweep"):
+        for _ in range(4):
+            f(x)
+    with pytest.raises(RecompilationError):
+        with recompile_sentinel(label="fixture leak"):
+            jax.jit(lambda a: a * a + 0.5)(x)
+
+
+def test_engine_batch_sweep_stays_compiled(recompile_sentinel):
+    """The client-sweep wiring the sentinel exists for: after warmup, a
+    steady run of same-shape engine batches must not touch XLA — one
+    per-batch recompile here is the throughput collapse DW102 describes
+    statically."""
+    from dwpa_tpu import testing as synth
+    from dwpa_tpu.models.m22000 import M22000Engine
+
+    eng = M22000Engine(
+        [synth.make_pmkid_line(b"sentinel-psk", b"SentinelNet", seed="sn1")],
+        batch_size=64,
+    )
+    eng.crack_batch([b"warm-%04d" % i for i in range(64)])
+    with recompile_sentinel(allowed=0, label="engine batch sweep"):
+        for rep in range(3):
+            eng.crack_batch([b"sweep%d-%04d" % (rep, i) for i in range(64)])
+
+
+# ---------------------------------------------------------------------------
+# contract checker
+# ---------------------------------------------------------------------------
+
+
+_GOOD_TREE = {
+    "dwpa_tpu/client/protocol.py": """
+        def get_work(self, dictcount):
+            work = self.fetch({"dictcount": dictcount})
+            for field in ("hkey", "dicts", "hashes"):
+                if field not in work:
+                    raise ValueError(field)
+            return work
+
+        def put_work(self, hkey, candidates):
+            return self.fetch({"hkey": hkey, "type": "bssid",
+                               "cand": candidates})
+    """,
+    "dwpa_tpu/client/main.py": """
+        def process(self, work):
+            for d in work.get("dicts", []):
+                self.download(d["dpath"], d["dhash"])
+            work["_progress"] = 1
+            cand = [{"k": "aa", "v": "bb"}]
+            return work["hkey"], work.get("rules"), cand
+    """,
+    "dwpa_tpu/server/core.py": """
+        def get_work(self, dictcount):
+            dicts = self.db.q("SELECT * FROM dicts")
+            work = {
+                "hkey": "h",
+                "dicts": [{"dhash": d["dhash"], "dpath": d["dpath"]}
+                          for d in dicts],
+                "hashes": [],
+            }
+            work["rules"] = "r"
+            return work
+
+        def put_work(self, data):
+            cands = data.get("cand") or []
+            for pair in cands:
+                k, v = pair.get("k"), pair.get("v")
+            return data.get("hkey"), data.get("type"), data.get("ip")
+    """,
+    "dwpa_tpu/server/api.py": """
+        def route(core, data, environ):
+            data.setdefault("ip", environ.get("REMOTE_ADDR", ""))
+            return core.put_work(data)
+    """,
+    "dwpa_tpu/server/db.py": '''
+        SCHEMA = """
+        CREATE TABLE dicts (
+            d_id INTEGER PRIMARY KEY,
+            dpath TEXT, dname TEXT, dhash TEXT, rules TEXT, wcount INTEGER
+        );
+        CREATE TABLE nets (net_id INTEGER PRIMARY KEY, ssid BLOB);
+        """
+
+        def add_dict(db):
+            db.x("INSERT INTO dicts(dpath, dname, dhash) VALUES (?,?,?)")
+    ''',
+}
+
+
+def _write_tree(tmp_path, overrides=None):
+    files = dict(_GOOD_TREE, **(overrides or {}))
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def test_contracts_clean_tree(tmp_path):
+    assert check_contracts(_write_tree(tmp_path)) == []
+
+
+def test_contracts_dw201_client_reads_unemitted_field(tmp_path):
+    root = _write_tree(tmp_path, {"dwpa_tpu/client/main.py": """
+        def process(self, work):
+            return work["hkey"], work["wordlist_url"]
+    """})
+    vs = check_contracts(root)
+    assert [v.code for v in vs] == ["DW201"]
+    assert "wordlist_url" in vs[0].detail
+
+
+def test_contracts_dw201_underscore_keys_are_client_local(tmp_path):
+    root = _write_tree(tmp_path, {"dwpa_tpu/client/main.py": """
+        def process(self, work):
+            return work["hkey"], work.get("_progress"), work["_ver"]
+    """})
+    assert check_contracts(root) == []
+
+
+def test_contracts_dw202_dict_entry_drift(tmp_path):
+    root = _write_tree(tmp_path, {"dwpa_tpu/client/main.py": """
+        def process(self, work):
+            for d in work.get("dicts", []):
+                self.download(d["dpath"], d["dsize"])
+            return work["hkey"]
+    """})
+    vs = check_contracts(root)
+    assert [v.code for v in vs] == ["DW202"]
+    assert "dsize" in vs[0].detail
+
+
+def test_contracts_dw202_server_entry_key_not_a_column(tmp_path):
+    bad_core = _GOOD_TREE["dwpa_tpu/server/core.py"].replace(
+        '"dhash": d["dhash"]', '"dgest": d["dhash"]')
+    root = _write_tree(tmp_path, {"dwpa_tpu/server/core.py": bad_core})
+    vs = check_contracts(root)
+    # two sightings of the same drift: the client reads "dhash" which the
+    # server no longer emits, and "dgest" matches no dicts column
+    assert codes(vs) == ["DW202", "DW202"]
+    assert any("dgest" in v.detail for v in vs)
+
+
+def test_contracts_dw203_server_reads_unsent_field(tmp_path):
+    bad_core = _GOOD_TREE["dwpa_tpu/server/core.py"].replace(
+        'data.get("type")', 'data.get("claim_type")')
+    root = _write_tree(tmp_path, {"dwpa_tpu/server/core.py": bad_core})
+    vs = check_contracts(root)
+    assert [v.code for v in vs] == ["DW203"]
+    assert "claim_type" in vs[0].detail
+
+
+def test_contracts_dw204_insert_unknown_column(tmp_path):
+    bad_db = _GOOD_TREE["dwpa_tpu/server/db.py"].replace(
+        "INSERT INTO dicts(dpath, dname, dhash)",
+        "INSERT INTO dicts(dpath, dname, digest)")
+    root = _write_tree(tmp_path, {"dwpa_tpu/server/db.py": bad_db})
+    vs = check_contracts(root)
+    assert [v.code for v in vs] == ["DW204"]
+    assert "digest" in vs[0].detail
+
+
+def test_contracts_real_tree_is_clean():
+    """The shipped client/server/schema agree — this is the check that
+    catches protocol drift at test time, not in production."""
+    assert check_contracts(repo_root()) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics + the tier-1 full-tree run
+# ---------------------------------------------------------------------------
+
+
+def _viol(code="DW104", path="a.py", snippet="x = 1", line=3):
+    from dwpa_tpu.analysis.linter import Violation
+
+    return Violation(code, path, line, "msg", snippet)
+
+
+def test_baseline_absorbs_by_fingerprint_not_line():
+    base = {(v.code, v.path, v.snippet): 1 for v in [_viol(line=3)]}
+    new, absorbed, stale = apply_baseline([_viol(line=99)], base)
+    assert new == [] and len(absorbed) == 1 and stale == []
+
+
+def test_baseline_multiplicity_and_new_and_stale():
+    base = {("DW104", "a.py", "x = 1"): 2}
+    vs = [_viol(), _viol(), _viol(),             # 3 occurrences, budget 2
+          _viol(code="DW103", snippet="y = 2")]  # not baselined
+    new, absorbed, stale = apply_baseline(vs, base)
+    assert len(absorbed) == 2
+    assert sorted(v.code for v in new) == ["DW103", "DW104"]
+    assert stale == []
+    # all fixed -> entry reported stale, nothing fails
+    new2, absorbed2, stale2 = apply_baseline([], base)
+    assert new2 == [] and stale2 == [("DW104", "a.py", "x = 1")]
+
+
+def test_baseline_write_load_round_trip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    write_baseline([_viol(), _viol(), _viol(code="DW103")], path)
+    data = json.loads(open(path).read())
+    assert data["version"] == 1
+    loaded = load_baseline(path)
+    assert loaded[("DW104", "a.py", "x = 1")] == 2
+    assert loaded[("DW103", "a.py", "x = 1")] == 1
+
+
+def test_full_tree_clean_under_checked_in_baseline():
+    """The acceptance gate: ``python -m dwpa_tpu.analysis`` exits 0 on
+    this tree with the checked-in baseline — every hot-path sync is
+    individually accepted, and anything NEW fails tier-1 right here."""
+    logs = []
+    rc = run_analysis(log=logs.append)
+    assert rc == 0, "\n".join(logs)
+
+
+def test_full_tree_violations_all_known_codes():
+    known = {"DW101", "DW102", "DW103", "DW104", "DW105",
+             "DW201", "DW202", "DW203", "DW204"}
+    vs = collect_violations(repo_root())
+    assert vs, "the baseline documents accepted syncs; none found?"
+    assert {v.code for v in vs} <= known
+
+
+def test_cli_exits_nonzero_on_new_violation(tmp_path):
+    """End-to-end CLI contract on a tree seeded with a fresh violation
+    and an empty baseline."""
+    from dwpa_tpu.analysis.__main__ import main as cli_main
+
+    root = _write_tree(tmp_path)
+    (tmp_path / "dwpa_tpu/ops").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "dwpa_tpu/ops/bad.py").write_text(
+        "import jax.numpy as jnp\nBAD = jnp.float64\n")
+    empty = tmp_path / "empty_baseline.json"
+    empty.write_text('{"version": 1, "violations": []}')
+    assert cli_main([root, "--baseline", str(empty)]) == 1
+    # --update-baseline accepts the tree, after which the run is green
+    assert cli_main([root, "--baseline", str(empty),
+                     "--update-baseline"]) == 0
+    assert cli_main([root, "--baseline", str(empty)]) == 0

@@ -157,6 +157,61 @@ def test_corrupt_resume_discarded(server, tmp_path):
     assert not os.path.exists(client.resume_path)
 
 
+def test_resume_rejected_on_batch_size_change(server, tmp_path):
+    """A resume written under a different -b must restart the unit, not
+    skip-by-count: the batch size changes crack_rules' chunk boundaries,
+    so the old done counter indexes a DIFFERENT candidate order and a
+    replay would silently skip candidates that were never tried."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="bs1")])
+    _add_dict(server, [PSK])
+    crashed = _client(server, tmp_path, batch_size=64)
+    work = crashed.api.get_work(1)
+    work["_progress"] = {"done": 37, "cand": []}  # mid-unit checkpoint
+    crashed._write_resume(work)
+    assert work["_batch"] == 64  # stamped alongside _ver/_nproc
+
+    # same build, same topology, different -b: the snapshot is discarded
+    revived = _client(server, tmp_path, batch_size=32)
+    assert revived._read_resume() is None
+    assert not os.path.exists(revived.resume_path)
+
+    # unchanged -b still replays (the stamp must not over-reject)
+    crashed._write_resume(work)
+    same = _client(server, tmp_path, batch_size=64)
+    assert same._read_resume() == work
+
+
+def test_shard_word_blocks_covers_stream_in_lockstep():
+    """The no-rules pass-2 slicer (multi-host): per block, the hosts'
+    shards partition the global stream in order, every host yields the
+    SAME number of same-sized batches (padding, not absence, for short
+    tails — the SPMD-lockstep contract), and the reported global counts
+    sum to the stream length."""
+    from dwpa_tpu.client.main import shard_word_blocks
+
+    words = [b"w%05d" % i for i in range(2 * 3 * 16 + 11)]  # ragged tail
+    nproc, bs = 3, 16
+    per_host = [list(shard_word_blocks(words, nproc, pid, bs))
+                for pid in range(nproc)]
+    # identical block structure on every host
+    nblocks = {len(h) for h in per_host}
+    assert nblocks == {len(per_host[0])}
+    for blocks in zip(*per_host):
+        sizes = {len(mine) for mine, _ in blocks}
+        gcounts = {g for _, g in blocks}
+        assert len(sizes) == 1 and len(gcounts) == 1  # lockstep
+    # concatenating the hosts' shards per block (padding stripped)
+    # reconstructs the global stream exactly once, in order
+    rebuilt = []
+    for blocks in zip(*per_host):
+        for mine, _ in blocks:
+            rebuilt.extend(w for w in mine if w != b"")
+    assert rebuilt == words
+    assert sum(g for _, g in per_host[0]) == len(words)
+    # full blocks shard to exactly batch_size per host
+    assert all(len(mine) == bs for mine, _ in per_host[1][:-1])
+
+
 def test_dict_md5_mismatch_rejected(server, tmp_path):
     """A corrupted dict download fails the md5 gate (help_crack.py:533-534)."""
     _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="md5-1")])

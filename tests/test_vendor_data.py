@@ -104,6 +104,45 @@ def test_pack_validation_rejects_bad_kind():
             {"name": "x", "ssid_re": "^V", "kind": "mac_map"}]})
 
 
+def test_fixed_keys_type_checked_at_load():
+    """Non-string / empty fixed keys fail at load (a JSON number would
+    TypeError on .encode() on the first matching net mid-cron)."""
+    for keys in ([123], [None], [["nested"]], ["ok", ""], [], "notalist"):
+        with pytest.raises(ValueError, match="fixed"):
+            load_vendor_pack({"families": [
+                {"name": "f", "ssid_re": "^F", "kind": "fixed",
+                 "keys": keys}]})
+    # the valid shape still loads
+    assert load_vendor_pack({"families": [
+        {"name": "f", "ssid_re": "^F", "kind": "fixed", "keys": ["k1"]}]})
+
+
+def test_serial_hash_ssid_re_group_validated_at_load():
+    """serial_hash feeds m.group(1) to the serial scheme, so the regex
+    must guarantee exactly one mandatory capture group — an optional or
+    alternated group would match with group(1) = None and raise
+    AttributeError mid-cron instead of a clear load error."""
+    series = {"96": [{"sn": "55501", "q": 0, "k": 1}]}
+    bad_patterns = [
+        r"^SerNet-\d{8}$",              # no group at all
+        r"^SerNet-(\d{4})(\d{4})$",     # two groups
+        r"^SerNet-(\d{8})?$",           # optional: group may be None
+        r"^SerNet-(\d{8})*x$",          # star repeat: may be None
+        r"^(?:A(\d{8})|B\d{8})$",       # group absent in one branch
+    ]
+    for pat in bad_patterns:
+        with pytest.raises(ValueError, match="mandatory capture group"):
+            load_vendor_pack({"families": [
+                {"name": "s", "ssid_re": pat, "kind": "serial_hash",
+                 "series": series}]})
+    # mandatory-group shapes still load: plain, and under a +-repeat
+    # (min >= 1 guarantees participation)
+    for pat in (r"^SerNet-(\d{8})$", r"^S(?:erNet-(\d{8}))+$"):
+        assert load_vendor_pack({"families": [
+            {"name": "s", "ssid_re": pat, "kind": "serial_hash",
+             "series": series}]})
+
+
 def test_pack_validation_checks_data_at_load():
     """Value errors must surface at load — not on the first matching net
     mid-cron (the jobs loop would retry the failing tick forever)."""
