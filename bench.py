@@ -17,12 +17,18 @@ Timing notes: every sample forces a device->host fetch of the result
 ``block_until_ready`` returns before execution completes, so dispatch-only
 timing overstates throughput by orders of magnitude.  Each repetition
 feeds distinct inputs so no layer can serve a cached result.
+
+Every timed region runs through the obs span tracer (dwpa_tpu.obs), so
+the numbers in this JSON line and the live ``dwpa_span_seconds``
+telemetry are the SAME measurement — they cannot disagree.  The spans
+inherit the sync rule above: each region's body ends in an engine
+``crack*`` call or an ``np.asarray`` fetch (lint rule DW106 checks
+this file statically, as DW105 did for the raw perf_counter spans).
 """
 
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -31,6 +37,9 @@ import jax
 from dwpa_tpu import testing as T
 from dwpa_tpu.analysis import watch_compiles
 from dwpa_tpu.models.m22000 import M22000Engine
+from dwpa_tpu.obs import SpanTracer, default_registry
+
+TRACER = SpanTracer(default_registry())
 
 RTX4090_PMKS = 2.5e6           # hashcat-CUDA m22000 on one RTX 4090
 PER_CHIP_TARGET = 2 * RTX4090_PMKS / 8   # north-star share per v5e chip
@@ -103,9 +112,9 @@ def bench_mask_pbkdf2(batch: int, batches: int = 8) -> dict:
     # nonzero ``recompiles`` means the timed run paid XLA compile time.
     engine.crack_mask(mask, skip=n, limit=batch)
     with watch_compiles() as comp:
-        t0 = time.perf_counter()
-        engine.crack_mask(mask, skip=0, limit=n)
-        dt = time.perf_counter() - t0
+        with TRACER.span("bench:mask_pbkdf2") as sp:
+            engine.crack_mask(mask, skip=0, limit=n)
+        dt = sp.seconds
     return {"pmk_per_s": n / dt, "batch": batch, "batches": batches,
             "seconds": dt, "candidate_gen": "on-device",
             "recompiles": comp.count}
@@ -120,9 +129,9 @@ def bench_engine_dict(line: str, psk: bytes, words: int, label: str,
     # Warm the jit caches (PBKDF2 + verify kernels) on a no-match slice so
     # the timed run measures steady-state throughput, as hashcat reports it.
     engine.crack_batch([b"warmup-%06d" % i for i in range(batch)])
-    t0 = time.perf_counter()
-    founds = engine.crack(dict_words)
-    dt = time.perf_counter() - t0
+    with TRACER.span(f"bench:{label}") as sp:
+        founds = engine.crack(dict_words)
+    dt = sp.seconds
     assert founds and founds[0].psk == psk, f"{label}: engine missed the known PSK"
     return {"label": label, "words": words, "seconds": dt, "pmk_per_s": words / dt}
 
@@ -150,9 +159,9 @@ def bench_rules_dict(words: int) -> dict:
     )
     engine.crack_rules([b"warm-%06d" % i for i in range(engine.batch_size)],
                        [rules[0], rules[-1]])
-    t0 = time.perf_counter()
-    founds = engine.crack_rules(base, rules)
-    dt = time.perf_counter() - t0
+    with TRACER.span("bench:rules_dict") as sp:
+        founds = engine.crack_rules(base, rules)
+    dt = sp.seconds
     assert founds and founds[0].psk == expanded_psk, "rules config missed the PSK"
     n = words * len(rules)
     return {"label": "rules_dict", "candidates": n, "seconds": dt,
@@ -203,7 +212,8 @@ def bench_rules_device(batch: int, n_rules: int = 8,
             batch_size=batch,
         )
         founds = []
-        dts.append(_timed(lambda: founds.extend(eng.crack_rules(base, rules))))
+        dts.append(_timed(lambda: founds.extend(eng.crack_rules(base, rules)),
+                          "bench:rules_device"))
         assert founds and founds[0].psk == psk, "rules_device missed the PSK"
     dt = min(dts)
     n = len(base) * len(rules)
@@ -228,9 +238,9 @@ def bench_multi_bssid(words: int) -> dict:
     dict_words = [b"candidate-%06d" % i for i in range(words - 1)] + [psk]
     engine = M22000Engine(lines, batch_size=min(4096, words))
     engine.crack_batch([b"warm-%06d" % i for i in range(engine.batch_size)])
-    t0 = time.perf_counter()
-    founds = engine.crack(dict_words)
-    dt = time.perf_counter() - t0
+    with TRACER.span("bench:multi_bssid") as sp:
+        founds = engine.crack(dict_words)
+    dt = sp.seconds
     assert len(founds) == n_nets, f"multi-bssid: {len(founds)}/{n_nets} cracked"
     return {"label": "multi_bssid", "nets": n_nets, "essids": n_essids,
             "seconds": dt, "pmk_per_s": words * n_essids / dt,
@@ -252,16 +262,19 @@ def bench_dict_steady(batch: int, batches: int = 8) -> dict:
     n = batches * batch
     with watch_compiles() as comp:
         dt = min(_timed(lambda: engine.crack(b"r%d-%08d" % (rep, i)
-                                             for i in range(n)))
+                                             for i in range(n)),
+                        "bench:dict_steady")
                  for rep in range(2))
     return {"label": "dict_steady", "words": n, "seconds": dt,
             "pmk_per_s": n / dt, "recompiles": comp.count}
 
 
-def _timed(fn) -> float:
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
+def _timed(fn, name: str = "bench:timed") -> float:
+    """One rep as a span: the body must sync its own device work (every
+    caller passes an engine crack* call, which does)."""
+    with TRACER.span(name) as sp:
+        fn()
+    return sp.seconds
 
 
 def bench_host_feed(words: int = 200_000) -> dict:
@@ -283,31 +296,32 @@ def bench_host_feed(words: int = 200_000) -> dict:
     base = [b"feedword%07d" % i for i in range(words // len(rules))]
     out = {"label": "host_feed"}
 
-    t0 = time.perf_counter()
-    n = sum(1 for _ in apply_rules(rules, base))
-    out["rules_serial_cand_per_s"] = n / (time.perf_counter() - t0)
+    with TRACER.span("bench:host_feed.rules_serial") as sp:
+        n = sum(1 for _ in apply_rules(rules, base))
+    out["rules_serial_cand_per_s"] = n / sp.seconds
 
     # Warm the worker pool first: spawning 2 interpreters costs ~10 s
     # once per process, amortized over a whole work unit in production.
     # force_pool bypasses the few-cores guard — the point here is to
     # track the true pooled rate even on hosts where the guard trips.
     sum(1 for _ in apply_rules(rules, base[:64], workers=2, force_pool=True))
-    t0 = time.perf_counter()
-    n = sum(1 for _ in apply_rules(rules, base, workers=2, force_pool=True))
-    out["rules_pooled2_cand_per_s"] = n / (time.perf_counter() - t0)
+    with TRACER.span("bench:host_feed.rules_pooled2") as sp:
+        n = sum(1 for _ in apply_rules(rules, base, workers=2,
+                                       force_pool=True))
+    out["rules_pooled2_cand_per_s"] = n / sp.seconds
 
     cands = [b"packword%07d" % i for i in range(words)]
-    t0 = time.perf_counter()
-    pack_candidates_fast(cands, 8, 63, words)
-    out["pack_fast_cand_per_s"] = words / (time.perf_counter() - t0)
+    with TRACER.span("bench:host_feed.pack_fast") as sp:
+        pack_candidates_fast(cands, 8, 63, words)
+    out["pack_fast_cand_per_s"] = words / sp.seconds
 
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "feed.txt.gz")
         with open(path, "wb") as f:
             f.write(gzip.compress(b"\n".join(cands) + b"\n"))
-        t0 = time.perf_counter()
-        n = sum(1 for _ in DictStream(path))
-        out["dictstream_words_per_s"] = n / (time.perf_counter() - t0)
+        with TRACER.span("bench:host_feed.dictstream") as sp:
+            n = sum(1 for _ in DictStream(path))
+        out["dictstream_words_per_s"] = n / sp.seconds
     return out
 
 

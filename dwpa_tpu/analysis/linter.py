@@ -37,6 +37,16 @@ Rule codes (stable — referenced by baseline.json and the docs):
   which sync internally) before the clock stops.  On the tunnelled TPU
   dispatch returns early, so such a span overstates throughput by
   orders of magnitude (see bench.py's timing notes).
+- **DW106 telemetry-discipline** — the obs-layer contract, two shapes:
+  (a) a metric/span emission call (``.inc()``/``.dec()``/``.set()``/
+  ``.observe()``, excluding jnp's ``x.at[i].set(v)`` functional update)
+  inside a function under a JAX trace — telemetry is host-side by
+  design, and an emission in traced code either fails on a tracer or
+  silently bakes a stale value into the compiled program; (b) an obs
+  span (``with tracer.span(...):`` body, or a ``.start(...)``/
+  ``.stop()`` pair) in the instrumented files (``SPAN_FILES``) that
+  launches device work without forcing completion before the clock
+  stops — DW105's device-sync rule, ported to the span API.
 
 The linter is repo-native, not general-purpose: rules are scoped to the
 paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
@@ -54,6 +64,12 @@ HOT_PATH_FILES = ("dwpa_tpu/parallel/step.py", "dwpa_tpu/models/m22000.py")
 BENCH_FILES = ("bench.py",)
 #: directories whose dtype lattice DW103 polices
 OPS_DIRS = ("dwpa_tpu/ops",)
+#: files whose obs spans DW106 polices for the device-sync rule (the
+#: span-instrumented surfaces; the in-trace emission check is global)
+SPAN_FILES = ("bench.py", "dwpa_tpu/client/main.py")
+
+#: metric-emission methods DW106 bans inside traced functions
+OBS_EMIT_METHODS = {"inc", "dec", "observe", "set"}
 
 #: callables that put their function argument under a JAX trace
 TRACE_ENTRYPOINTS = {
@@ -343,6 +359,22 @@ def _check_traced_function(fn, how, static_names, static_nums, path,
                         f"{sorted(hits)} inside traced function ({how}) — "
                         "host sync / ConcretizationTypeError",
                         _line(src_lines, node)))
+            elif (name in OBS_EMIT_METHODS
+                    and isinstance(node.func, ast.Attribute)
+                    and not _is_at_update(node.func)):
+                out.append(Violation(
+                    "DW106", path, node.lineno,
+                    f"metric/span emission .{name}() inside traced "
+                    f"function ({how}) — telemetry is host-side only; "
+                    "record after the device call returns",
+                    _line(src_lines, node)))
+
+
+def _is_at_update(f: ast.Attribute) -> bool:
+    """jnp's functional update ``x.at[i].set(v)`` (or any subscripted
+    base) is array code, not telemetry — exempt from the DW106
+    emission check."""
+    return any(isinstance(n, ast.Subscript) for n in ast.walk(f.value))
 
 
 # ---------------------------------------------------------------------------
@@ -512,6 +544,98 @@ def _check_timed_sections(tree, path, src_lines, out):
 
 
 # ---------------------------------------------------------------------------
+# DW106 span device-sync discipline (the obs-layer DW105)
+# ---------------------------------------------------------------------------
+
+
+def _is_span_open(call: ast.Call) -> bool:
+    """``<tracer>.span(name...)`` / ``<tracer>.start(name...)`` — the obs
+    span API.  The name argument requirement keeps zero-arg ``.start()``
+    (threads, servers) out of scope."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr in ("span", "start")
+            and bool(call.args or call.keywords))
+
+
+def _has_sync_kwarg(call: ast.Call) -> bool:
+    """``span(..., sync=...)`` / ``stop(sync=...)``: the API's built-in
+    fetch-before-clock-stop — counts as synced."""
+    return any(kw.arg == "sync" and not (isinstance(kw.value, ast.Constant)
+                                         and kw.value.value is None)
+               for kw in call.keywords)
+
+
+def _region_sync_violation(region, opener, label, fn_name, path,
+                           src_lines, out):
+    calls = [n for s in region for n in ast.walk(s)
+             if isinstance(n, ast.Call)]
+    launches = any(_is_devicework_call(c) for c in calls)
+    synced = any(_call_name(c) in SYNC_MARKERS for c in calls)
+    if launches and not synced:
+        out.append(Violation(
+            "DW106", path, opener.lineno,
+            f"span '{label}' in {fn_name}() launches device work but "
+            "never forces completion (engine crack* / np.asarray / "
+            "block_until_ready / sync=) before the clock stops",
+            _line(src_lines, opener)))
+
+
+def _span_label(call: ast.Call) -> str:
+    if call.args and isinstance(call.args[0], ast.Constant):
+        return str(call.args[0].value)
+    return "<dynamic>"
+
+
+def _check_span_sync(tree, path, src_lines, out):
+    seen_withs = set()  # a With in a nested def is walked by both defs
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # with <tracer>.span(...) [as sp]: — the region is the body
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With) or id(node) in seen_withs:
+                continue
+            seen_withs.add(id(node))
+            for item in node.items:
+                ce = item.context_expr
+                if (isinstance(ce, ast.Call) and _is_span_open(ce)
+                        and ce.func.attr == "span"
+                        and not _has_sync_kwarg(ce)):
+                    _region_sync_violation(
+                        node.body, node, _span_label(ce), fn.name,
+                        path, src_lines, out)
+        # sp = <tracer>.start(...) ... sp.stop() — statement-scoped,
+        # like DW105's clock pairs
+        stmts = fn.body
+        for i, stmt in enumerate(stmts):
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and _is_span_open(stmt.value)
+                    and stmt.value.func.attr == "start"
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            sp_name = stmt.targets[0].id
+            stop = stop_call = None
+            for j in range(i + 1, len(stmts)):
+                for n in ast.walk(stmts[j]):
+                    if (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Attribute)
+                            and n.func.attr == "stop"
+                            and isinstance(n.func.value, ast.Name)
+                            and n.func.value.id == sp_name):
+                        stop, stop_call = j, n
+                        break
+                if stop is not None:
+                    break
+            if stop is None or _has_sync_kwarg(stop_call):
+                continue
+            _region_sync_violation(
+                stmts[i + 1:stop], stmt, _span_label(stmt.value), fn.name,
+                path, src_lines, out)
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -535,6 +659,8 @@ def lint_source(src: str, path: str) -> list:
         _check_hot_path_syncs(tree, path, src_lines, out)
     if path in BENCH_FILES:
         _check_timed_sections(tree, path, src_lines, out)
+    if path in SPAN_FILES:
+        _check_span_sync(tree, path, src_lines, out)
     return out
 
 

@@ -44,6 +44,9 @@ def build_parser():
 
 
 def main(argv=None):
+    from ..obs import setup_logging
+
+    setup_logging()  # console format preserved; DWPA_LOG=json for pipelines
     parser = build_parser()
     args = parser.parse_args(argv)
     manual = (args.coordinator, args.num_processes, args.process_id)

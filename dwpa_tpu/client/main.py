@@ -29,9 +29,12 @@ from dataclasses import dataclass, field
 
 import jax
 
+from ..analysis import watch_compiles
 from ..gen import DictStream, psk_candidates
 from ..models import hashline as hl
 from ..models.m22000 import M22000Engine
+from ..obs import (SpanTracer, default_registry, get_logger, is_emitter,
+                   merged_slice_snapshot, setup_logging)
 from ..rules import apply_rules, parse_rules
 from .. import __version__
 from .. import testing as synth
@@ -156,10 +159,47 @@ class WorkResult:
 
 
 class TpuCrackClient:
-    def __init__(self, config: ClientConfig, api: ServerAPI = None, log=print):
+    def __init__(self, config: ClientConfig, api: ServerAPI = None, log=None,
+                 registry=None):
         self.cfg = config
         self.api = api or ServerAPI(config.base_url)
+        if log is None:
+            # one logging config for the whole process (obs.setup_logging
+            # is idempotent); DWPA_LOG=json switches to structured lines
+            setup_logging()
+            log = get_logger("client").info
         self.log = log
+        # Telemetry: all client metrics/spans land in one registry
+        # (injectable for tests; default: the process-wide one).  The
+        # transport layer is bound to the same registry so get_work/
+        # put_work/dict-download counters + spans appear next to the
+        # crack-loop spans.  Recording is pure host-side work — nothing
+        # here may touch a device value (lint rule DW106).
+        self.registry = registry or default_registry()
+        self.tracer = SpanTracer(self.registry)
+        bind = getattr(self.api, "bind_obs", None)
+        if bind is not None:  # duck-typed test doubles stay unbound
+            bind(self.registry, self.tracer)
+        reg = self.registry
+        self._m_pmks = reg.gauge(
+            "dwpa_client_pmk_per_s",
+            "candidates/s through the engine, by crack pass")
+        self._m_autotune = reg.counter(
+            "dwpa_client_autotune_total",
+            "dictcount autotune decisions, by direction")
+        self._m_dictcount = reg.gauge(
+            "dwpa_client_dictcount", "current work-unit dictionary count")
+        self._m_resume = reg.counter(
+            "dwpa_client_resume_skipped_total",
+            "candidates fast-forwarded by resume replay")
+        self._m_recompiles = reg.counter(
+            "dwpa_client_recompiles_total",
+            "XLA compile-cache misses observed inside work units")
+        self._m_units = reg.counter(
+            "dwpa_client_work_units_total",
+            "work units completed, by server verdict")
+        self._m_founds = reg.counter(
+            "dwpa_client_founds_total", "cracked PSKs recovered")
         if config.additional_dict and jax.process_count() > 1:
             # A per-host local file cannot feed a multi-host slice: the
             # pass-1 streams must be byte-identical on every host or the
@@ -181,6 +221,7 @@ class TpuCrackClient:
         self._digest_cache = {}  # (path, size, mtime_ns) -> md5 hex
         self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
         self.dictcount = max(1, min(15, config.dictcount))
+        self._m_dictcount.set(self.dictcount)
         # cracked/rkg refresh countdown: primed to refresh on first use,
         # then every cfg.cracked_refresh units (DAW dl_count semantics).
         self._cracked_countdown = 0
@@ -226,9 +267,10 @@ class TpuCrackClient:
             synth.make_pmkid_line(CHALLENGE_PSK, b"dlink", seed="challenge-p"),
             synth.make_eapol_line(CHALLENGE_PSK, b"dlink", keyver=2, seed="challenge-e"),
         ]
-        eng = M22000Engine(lines, nc=self.cfg.nc, batch_size=64)
-        words = [b"notit%04d" % i for i in range(63)] + [CHALLENGE_PSK]
-        founds = eng.crack(words)
+        with self.tracer.span("challenge"):
+            eng = M22000Engine(lines, nc=self.cfg.nc, batch_size=64)
+            words = [b"notit%04d" % i for i in range(63)] + [CHALLENGE_PSK]
+            founds = eng.crack(words)
         ok = len(founds) == 2 and all(f.psk == CHALLENGE_PSK for f in founds)
         self.log(f"challenge: {'passed' if ok else 'FAILED'}")
         if ok:
@@ -251,7 +293,9 @@ class TpuCrackClient:
         __init__) the compile happens once per installation; afterwards
         this is ~0.2 s of device work.
         """
-        t0 = time.time()
+        # perf_counter, not time.time(): an NTP step mid-prewarm must not
+        # corrupt the logged duration (same rule as the pacing clock)
+        sp = self.tracer.start("prewarm")
         eng = M22000Engine(
             [
                 synth.make_pmkid_line(CHALLENGE_PSK, b"dlink", seed="challenge-p"),
@@ -277,7 +321,10 @@ class TpuCrackClient:
 
             eng.crack_rules([b"warm-%08d" % i for i in range(n)],
                             parse_rules([":", "c $1 $2"]))
-        self.log(f"prewarm: work-size steps ready in {time.time() - t0:.1f}s")
+        # crack_batch/crack_rules sync internally (hits gate), so the
+        # span's clock stops after real device completion
+        sp.stop()
+        self.log(f"prewarm: work-size steps ready in {sp.seconds:.1f}s")
 
     # -- work-unit plumbing ------------------------------------------------
 
@@ -533,7 +580,18 @@ class TpuCrackClient:
             yield from DictStream(path)
 
     def process_work(self, work: dict) -> WorkResult:
-        t0 = time.time()
+        """One work unit, traced end to end: the ``work_unit`` span
+        parents the phase spans (pass1/pass2 here; dict_download and
+        put_work via the bound transport), and the pass PMK/s gauges +
+        recompile counter record inside."""
+        with self.tracer.span("work_unit"):
+            return self._process_work(work)
+
+    def _process_work(self, work: dict) -> WorkResult:
+        # perf_counter: the elapsed drives the 900 s dictcount autotune
+        # and the logged unit time — a wall-clock NTP step must not
+        # corrupt either (time.time() did exactly that before)
+        t0 = time.perf_counter()
         # Intra-unit resume (the hashcat --session analog): _progress
         # carries completed-candidate count and prior founds; the stream
         # is deterministic, so skipping replays exactly the unfinished
@@ -554,6 +612,8 @@ class TpuCrackClient:
 
             skip = int(multihost_utils.broadcast_one_to_all(_np.int64(skip)))
         self._resuming = skip > 0
+        if skip:
+            self._m_resume.inc(skip)
         if not self._resuming:
             # once per unit: a resume replay must not duplicate the entry
             self._archive_work(work)
@@ -582,54 +642,76 @@ class TpuCrackClient:
         # help_crack.py:773's ``-S -r``), where candidates never exist
         # host-side; crack_rules' own skip honors the same count contract.
         rules = self._rules(work)
-        stream1 = iter(self._pass1_candidates(engine, work, rules))
-        skipped = 0
-        if skip:
-            self.log(f"resuming work unit at candidate {skip}")
-            skipped = sum(1 for _ in itertools.islice(stream1, skip))
-        engine.crack(stream1, on_batch=on_batch)
-        skip2 = skip - skipped
-        words = self._pass2_words(work)
-        if rules:
-            # Single- AND multi-process: crack_rules takes the full
-            # global dict stream (every host downloads whole dicts
-            # anyway) and shards internally — each host uploads only its
-            # 1/nproc row slice and decodes finds from the replicated
-            # bitmask, so no host ever feeds expanded candidates.
-            engine.crack_rules(words, rules, on_batch=on_batch, skip=skip2)
-        elif jax.process_count() > 1:
-            # No-rules pass 2 shards too (it used to run replicated —
-            # nproc× redundant PBKDF2 on the bulk of the unit): each
-            # host feeds its block slice of the global stream, padded so
-            # batch counts stay in SPMD lockstep, and the checkpoint
-            # counter keeps counting GLOBAL stream positions (the resume
-            # skip below is applied to the global stream, so the two
-            # must agree or a resume would skip untried candidates).
-            for _ in itertools.islice(words, skip2):
-                pass
-            blocks = shard_word_blocks(words, jax.process_count(),
-                                       jax.process_index(),
-                                       self.cfg.batch_size)
-            global_counts = []
+        # The compile sentinel wraps both passes: a steady-state unit
+        # must not pay XLA time (prewarm covered the shapes), and when
+        # one does, the counter makes it visible fleet-wide instead of
+        # showing up only as a mysteriously slow unit.
+        with watch_compiles() as comp:
+            with self.tracer.span("pass1") as sp1:
+                stream1 = iter(self._pass1_candidates(engine, work, rules))
+                skipped = 0
+                if skip:
+                    self.log(f"resuming work unit at candidate {skip}")
+                    skipped = sum(1 for _ in itertools.islice(stream1, skip))
+                engine.crack(stream1, on_batch=on_batch)
+            # engine.crack syncs internally (hits gate), so sp1's clock
+            # stopped after real device completion; the gauge counts
+            # candidates/s — PMKs computed per candidate per essid group
+            tried1 = done - skip
+            if tried1 and sp1.seconds > 0:
+                self._m_pmks.labels(**{"pass": "1"}).set(tried1 / sp1.seconds)
+            skip2 = skip - skipped
+            with self.tracer.span("pass2") as sp2:
+                words = self._pass2_words(work)
+                if rules:
+                    # Single- AND multi-process: crack_rules takes the
+                    # full global dict stream (every host downloads whole
+                    # dicts anyway) and shards internally — each host
+                    # uploads only its 1/nproc row slice and decodes
+                    # finds from the replicated bitmask, so no host ever
+                    # feeds expanded candidates.
+                    engine.crack_rules(words, rules, on_batch=on_batch,
+                                       skip=skip2)
+                elif jax.process_count() > 1:
+                    # No-rules pass 2 shards too (it used to run
+                    # replicated — nproc× redundant PBKDF2 on the bulk of
+                    # the unit): each host feeds its block slice of the
+                    # global stream, padded so batch counts stay in SPMD
+                    # lockstep, and the checkpoint counter keeps counting
+                    # GLOBAL stream positions (the resume skip below is
+                    # applied to the global stream, so the two must agree
+                    # or a resume would skip untried candidates).
+                    for _ in itertools.islice(words, skip2):
+                        pass
+                    blocks = shard_word_blocks(words, jax.process_count(),
+                                               jax.process_index(),
+                                               self.cfg.batch_size)
+                    global_counts = []
 
-            def local_words():
-                for mine, gcount in blocks:
-                    global_counts.append(gcount)
-                    yield from mine
+                    def local_words():
+                        for mine, gcount in blocks:
+                            global_counts.append(gcount)
+                            yield from mine
 
-            def on_block(consumed, new_founds):
-                # one engine batch per block, in stream order — report
-                # the block's global coverage, not the local shard rows
-                on_batch(global_counts.pop(0), new_founds)
+                    def on_block(consumed, new_founds):
+                        # one engine batch per block, in stream order —
+                        # report the block's global coverage, not the
+                        # local shard rows
+                        on_batch(global_counts.pop(0), new_founds)
 
-            engine.crack(local_words(), on_batch=on_block)
-        else:
-            for _ in itertools.islice(words, skip2):
-                pass
-            engine.crack(words, on_batch=on_batch)
+                    engine.crack(local_words(), on_batch=on_block)
+                else:
+                    for _ in itertools.islice(words, skip2):
+                        pass
+                    engine.crack(words, on_batch=on_batch)
         tried = done - skip
+        tried2 = tried - tried1
+        if tried2 and sp2.seconds > 0:
+            self._m_pmks.labels(**{"pass": "2"}).set(tried2 / sp2.seconds)
+        if comp.count:
+            self._m_recompiles.inc(comp.count)
 
-        elapsed = time.time() - t0
+        elapsed = time.perf_counter() - t0
         st = engine.stage_times
         crack_s = sum(st.values())
         self.log(
@@ -644,6 +726,7 @@ class TpuCrackClient:
         )
         if founds:
             self._record_founds(founds)
+            self._m_founds.inc(len(founds))
         # prior founds from a resumed session are re-submitted: put_work
         # is idempotent server-side and the claim may not have landed
         cand = prior_cand + [
@@ -670,6 +753,8 @@ class TpuCrackClient:
             result.accepted = bool(payload["acc"])
         else:
             result.accepted = self.api.put_work(work["hkey"], cand)
+        self._m_units.labels(
+            accepted="true" if result.accepted else "false").inc()
         self._clear_resume()
         self._autotune(elapsed)
         return result
@@ -677,8 +762,11 @@ class TpuCrackClient:
     def _autotune(self, elapsed: float):
         if elapsed < self.cfg.pace_target and self.dictcount < 15:
             self.dictcount += 1
+            self._m_autotune.labels(direction="up").inc()
         elif elapsed > self.cfg.pace_target and self.dictcount > 1:
             self.dictcount -= 1
+            self._m_autotune.labels(direction="down").inc()
+        self._m_dictcount.set(self.dictcount)
 
     def run(self) -> int:
         """Update-check + challenge-gate, then loop work units.
@@ -762,4 +850,20 @@ class TpuCrackClient:
                 f"{res.candidates_tried} candidates in {res.elapsed:.0f}s "
                 f"(accepted={res.accepted}, dictcount->{self.dictcount})"
             )
+            if multiproc:
+                self._slice_report()
         return done
+
+    def _slice_report(self):
+        """COLLECTIVE (multi-host only): merge every host's registry and
+        report slice-wide throughput ONCE — the slice is one volunteer,
+        so its PMK/s must not appear nproc times.  Every host must reach
+        this call (it sits on the per-unit path after put_work, which
+        every host completes) or the allgather would strand the peers."""
+        merged = merged_slice_snapshot(self.registry)
+        if is_emitter():
+            p1 = merged.value("dwpa_client_pmk_per_s", **{"pass": "1"}) or 0.0
+            p2 = merged.value("dwpa_client_pmk_per_s", **{"pass": "2"}) or 0.0
+            self.log(
+                f"slice PMK/s: pass1={p1:.0f} pass2={p2:.0f} "
+                f"(summed over {jax.process_count()} hosts)")

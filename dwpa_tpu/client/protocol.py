@@ -21,6 +21,7 @@ configurable so tests and batch runs can fail fast instead of spinning
 forever.
 """
 
+import contextlib
 import gzip
 import hashlib
 import json
@@ -49,6 +50,29 @@ class ServerAPI:
         self.max_tries = max_tries  # 0 = retry forever (reference behavior)
         self.backoff = backoff
         self.sleep = sleep
+        # Telemetry binding (bind_obs): every protocol op counts into
+        # dwpa_client_requests_total{endpoint=...} and opens a span, so
+        # server-conversation time is visible next to crack time.  Unbound
+        # (bare ServerAPI uses) stays zero-overhead.
+        self._obs_requests = None
+        self._obs_tracer = None
+
+    def bind_obs(self, registry, tracer=None):
+        """Attach a metrics registry (and optional SpanTracer): done by
+        TpuCrackClient so transport ops land in the client's registry."""
+        self._obs_requests = registry.counter(
+            "dwpa_client_requests_total",
+            "client->server protocol operations by endpoint")
+        self._obs_tracer = tracer
+        return self
+
+    def _observed(self, endpoint: str):
+        """Count + span one protocol op (no-op context when unbound)."""
+        if self._obs_requests is not None:
+            self._obs_requests.labels(endpoint=endpoint).inc()
+        if self._obs_tracer is not None:
+            return self._obs_tracer.span(endpoint)
+        return contextlib.nullcontext()
 
     # -- low level ---------------------------------------------------------
 
@@ -83,9 +107,11 @@ class ServerAPI:
     # -- protocol ops ------------------------------------------------------
 
     def get_work(self, dictcount: int) -> dict:
-        raw = self.fetch(
-            self._endpoint("get_work=" + self.hc_ver), {"dictcount": dictcount}
-        )
+        with self._observed("get_work"):
+            raw = self.fetch(
+                self._endpoint("get_work=" + self.hc_ver),
+                {"dictcount": dictcount}
+            )
         text = raw.decode("utf-8", "replace").strip()
         if text == "Version":
             raise VersionRejected(f"server requires newer client than {self.hc_ver}")
@@ -99,15 +125,18 @@ class ServerAPI:
 
     def put_work(self, hkey: str, candidates: list) -> bool:
         """``candidates``: [{"k": bssid-12hex, "v": psk-hex}, ...]."""
-        raw = self.fetch(
-            self._endpoint("put_work"),
-            {"hkey": hkey, "type": "bssid", "cand": candidates},
-        )
+        with self._observed("put_work"):
+            raw = self.fetch(
+                self._endpoint("put_work"),
+                {"hkey": hkey, "type": "bssid", "cand": candidates},
+            )
         return raw.decode("utf-8", "replace").strip() == "OK"
 
     def get_prdict(self, hkey: str) -> list:
         """Fetch + gunzip the dynamic PROBEREQUEST dictionary."""
-        raw = self.fetch(self._endpoint("prdict=" + urllib.parse.quote(hkey)))
+        with self._observed("prdict"):
+            raw = self.fetch(
+                self._endpoint("prdict=" + urllib.parse.quote(hkey)))
         if raw[:2] == b"\x1f\x8b":
             raw = gzip.decompress(raw)
         return [w for w in raw.split(b"\n") if w]
@@ -133,7 +162,8 @@ class ServerAPI:
                  max_tries: int = None) -> str:
         if not urllib.parse.urlparse(url).scheme:
             url = urllib.parse.urljoin(self.base_url, url)
-        data = self.fetch(url, max_tries=max_tries)
+        with self._observed("dict_download"):
+            data = self.fetch(url, max_tries=max_tries)
         if expected_md5 is not None:
             got = hashlib.md5(data).hexdigest()
             if got != expected_md5:

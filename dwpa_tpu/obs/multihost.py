@@ -1,0 +1,67 @@
+"""Slice-wide telemetry agreement for multi-host meshes.
+
+The multi-host client contract (client/main.py ``_broadcast_json``): a
+slice is ONE very large volunteer, so its telemetry must be reported
+once, not ``nproc`` times.  Every host records into its own process-
+local registry (recording never needs a collective); at report points
+the hosts run ``merged_slice_snapshot`` TOGETHER — a fixed-shape
+allgather of JSON snapshots — and each host folds the others' counts
+into a merged view.  Only process 0 then *emits* (logs, serves
+``?metrics``): ``is_emitter()`` is the gate.
+
+Collective discipline, same as the client's other agreement helpers:
+two fixed-shape allgathers (lengths first, then max-padded payloads),
+so every host reaches every collective with identical shapes — a raise
+before either would strand the peers inside it, so callers must invoke
+this from a point every host reaches.
+"""
+
+import json
+
+
+def is_emitter() -> bool:
+    """True on the host that owns external emission (process 0; always
+    true single-process or before jax initializes a backend)."""
+    import jax
+
+    try:
+        return jax.process_index() == 0
+    except RuntimeError:  # no backend yet: single-host by definition
+        return True
+
+
+def allgather_json(obj):
+    """Every host's JSON-serializable ``obj``, in process order.
+
+    Single-process: ``[obj]`` with no jax involvement.  Multi-host: two
+    fixed-shape ``process_allgather`` rounds (lengths, then padded
+    payload bytes) — the equal-shape contract every host must honor."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [obj]
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    data = json.dumps(obj).encode()
+    lens = np.asarray(mhu.process_allgather(
+        np.asarray([len(data)], np.int64))).reshape(-1)
+    width = int(lens.max())
+    buf = np.zeros(width, np.uint8)
+    buf[: len(data)] = np.frombuffer(data, np.uint8)
+    rows = np.asarray(mhu.process_allgather(buf)).reshape(-1, width)
+    return [json.loads(bytes(r[: int(n)]).decode()) for r, n in zip(rows, lens)]
+
+
+def merged_slice_snapshot(registry):
+    """COLLECTIVE: every host contributes ``registry.snapshot()``; each
+    returns the slice-wide merge (counters/histograms summed, additive
+    gauges summed — see MetricsRegistry.merge_snapshot).  The merge is
+    identical on every host; emit it only where ``is_emitter()``."""
+    from .metrics import MetricsRegistry
+
+    snaps = allgather_json(registry.snapshot())
+    merged = MetricsRegistry()
+    for snap in snaps:
+        merged.merge_snapshot(snap)
+    return merged

@@ -22,6 +22,12 @@ positions encoded 0-9 then A-Z = 10..35; out-of-range positional ops leave
 the word unchanged — hashcat "rule position exceeds word length" no-ops).
 """
 
+from ..obs import get_logger
+
+# child of the package logger: one setup_logging() config (obs/logs.py)
+# covers the pool-guard warning below alongside every other emitter
+_log = get_logger(__name__)
+
 MAX_WORD = 256
 
 # positions/counts: 0-9, A-Z (10..35)
@@ -141,9 +147,7 @@ def apply_rules(rules, words, workers: int = 0, force_pool: bool = False):
             # once per (process, worker count): the condition can't
             # change at runtime and a client hits this per dict stream
             _POOL_GUARD_WARNED.add(workers)
-            import logging
-
-            logging.getLogger(__name__).warning(
+            _log.warning(
                 "rule-expansion pool disabled: %d workers need %d cores, host "
                 "has %d (pooled expansion measures slower than serial when "
                 "the pool contends with the feed process)",

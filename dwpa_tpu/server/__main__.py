@@ -114,6 +114,9 @@ def cmd_serve(args):
             )
             super().server_activate()
 
+    from ..obs import setup_logging
+
+    setup_logging()
     app = make_wsgi_app(_core(args))
     if getattr(args, "with_jobs", False):
         # The cron layer in-process: its own ServerCore (sqlite handles
@@ -202,8 +205,10 @@ def cmd_jobs(args):
     PSK lookup when a source is configured) by default, or continuous
     with --loop (maintenance hourly, keygen every 5 min, enrichment every
     10 min — the INSTALL.md:47-52 cadence)."""
+    from ..obs import setup_logging
     from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
 
+    setup_logging()
     core = _core(args)
     geo, psk = _job_lookups(args)
     if not args.loop:
@@ -224,11 +229,10 @@ def _jobs_loop(core, args, geo, psk):
     ``jobs --loop`` and ``serve --with-jobs``.  Transient job errors
     (sqlite lock contention, I/O hiccups) are logged and retried next
     tick — one bad pass must not end the cron layer for good."""
-    import sys
-    import traceback
-
+    from ..obs import get_logger
     from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
 
+    log = get_logger("server.jobs")
     gens = _keygen_gens(args)
     last_maint = last_enrich = 0.0
     while True:
@@ -245,8 +249,7 @@ def _jobs_loop(core, args, geo, psk):
                 last_enrich = now
             keygen_precompute(core, extra_generators=gens)
         except Exception:
-            print("jobs tick failed (will retry):", file=sys.stderr)
-            traceback.print_exc()
+            log.exception("jobs tick failed (will retry)")
         time.sleep(args.keygen_interval)
 
 

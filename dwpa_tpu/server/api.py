@@ -10,6 +10,11 @@ HTML chrome:
 - ``?prdict=<hkey>``   gzip dynamic dictionary stream (prdict.php);
 - ``?api``             cookie-keyed potfile export (api.php);
 - ``?stats``           JSON stats (the machine-readable face of stats.php);
+- ``?metrics``         Prometheus text-format v0.0.4 scrape of the live
+  telemetry registry (``?metrics=json`` for the JSON form) — request
+  counters + per-endpoint latency histograms recorded by this layer,
+  scheduler/claim counters from core.py, cron-job durations from
+  jobs.py, and scrape-time lease/net gauges (core.observe_metrics);
 - POST file upload     capture submission (index.php:4-11 besside path /
   content/submit.php) — accepts m22000 text, gz, or pcap/pcapng captures;
 - ``dict/<name>``      static dictionary downloads.
@@ -22,12 +27,35 @@ import json
 import gzip
 import os
 import re
+import time
 import urllib.parse
 
 from .core import ServerCore
 from .capture import extract_hashlines
 
 MIN_HC_VER = "2.1.1"  # oldest client protocol accepted (conf.php:29)
+
+#: machine endpoints + UI pages a request is attributed to in
+#: dwpa_http_requests_total{endpoint=...}; query keys win over paths so
+#: the label set stays closed (unknown paths all land in "other").
+_ENDPOINT_KEYS = ("metrics", "get_work", "put_work", "prdict", "api",
+                  "stats", "home", "get_key", "my_nets", "submit", "nets",
+                  "dicts", "search")
+
+
+def _endpoint_label(environ, qs) -> str:
+    for key in _ENDPOINT_KEYS:
+        if key in qs:
+            return key
+    path = environ.get("PATH_INFO", "/")
+    if path.startswith("/dict/"):
+        return "dict"
+    if path.startswith("/hc/"):
+        return "hc"
+    if path in ("", "/"):
+        return ("capture" if environ.get("REQUEST_METHOD") == "POST"
+                else "home")
+    return "other"
 
 
 def _version_ok(ver: str) -> bool:
@@ -44,20 +72,63 @@ class BodyTooLarge(Exception):
     """Request body exceeds the cap — reject, never silently truncate."""
 
 
-def make_wsgi_app(core: ServerCore):
+def make_wsgi_app(core: ServerCore, registry=None):
+    """WSGI front; every request lands in the telemetry registry
+    (default: the core's — one registry per deployment) as a
+    ``dwpa_http_requests_total{endpoint,status}`` count and a
+    ``dwpa_http_request_seconds{endpoint}`` latency observation, and
+    ``?metrics`` scrapes that same registry."""
+    from ..obs import is_emitter
+
+    registry = registry or getattr(core, "registry", None)
+    if registry is None:
+        from ..obs import default_registry
+
+        registry = default_registry()
+    req_count = registry.counter(
+        "dwpa_http_requests_total", "HTTP requests, by endpoint and status")
+    req_seconds = registry.histogram(
+        "dwpa_http_request_seconds", "HTTP request latency, by endpoint")
+
     def app(environ, start_response):
+        t0 = time.perf_counter()
+        qs = urllib.parse.parse_qs(environ.get("QUERY_STRING", ""),
+                                   keep_blank_values=True)
         try:
-            out = _route(core, environ)
+            # root-path only, like every other query route: unknown
+            # paths must stay 404 even when a ?metrics key rides along
+            if "metrics" in qs and environ.get("PATH_INFO", "/") in ("", "/"):
+                out = _metrics_response(core, registry, qs)
+            else:
+                out = _route(core, environ)
         except BodyTooLarge:
             out = ("413 Content Too Large", "text/plain", b"capture too large")
         except ValueError as e:
             out = ("400 Bad Request", "text/plain", str(e).encode())
         status, ctype, body = out[:3]
         extra_headers = list(out[3]) if len(out) > 3 else []
+        endpoint = _endpoint_label(environ, qs)
+        req_count.labels(endpoint=endpoint, status=status.split()[0]).inc()
+        req_seconds.labels(endpoint=endpoint).observe(
+            time.perf_counter() - t0)
         start_response(status, [("Content-Type", ctype),
                                 ("Content-Length", str(len(body)))]
                        + extra_headers)
         return [body]
+
+    def _metrics_response(core, registry, qs):
+        # Multi-host gate: on a multi-host mesh only process 0 owns
+        # emission (obs.multihost) — peers answer 404 so a fleet scrape
+        # config can point at every host without double counting.
+        if not is_emitter():
+            return ("404 Not Found", "text/plain",
+                    b"metrics served by process 0 only")
+        core.observe_metrics()
+        if qs["metrics"][0] == "json":
+            return ("200 OK", "application/json",
+                    registry.render_json().encode())
+        return ("200 OK", "text/plain; version=0.0.4; charset=utf-8",
+                registry.render_prometheus().encode())
 
     return app
 

@@ -127,8 +127,19 @@ class ServerCore:
     def __init__(self, db: Database, dictdir: str = None, capdir: str = None,
                  mailer=None, bosskey: str = None, captcha=None,
                  base_url: str = "", hcdir: str = None,
-                 capture_cap: int = None):
+                 capture_cap: int = None, registry=None):
+        from ..obs import default_registry
+
         self.db = db
+        # Telemetry sink shared by the WSGI front (api.make_wsgi_app
+        # reuses it), the scheduler counters below, and the cron jobs
+        # (jobs.py); injectable so tests get isolated registries.
+        self.registry = registry or default_registry()
+        self._m_issued = self.registry.counter(
+            "dwpa_server_work_issued_total", "work units handed to volunteers")
+        self._m_claims = self.registry.counter(
+            "dwpa_server_claims_total",
+            "put_work candidate claims, by verification verdict")
         self.dictdir = dictdir
         self.capdir = capdir
         # Upload size bound for captures (raw AND gzip-decompressed);
@@ -304,7 +315,10 @@ class ServerCore:
         be atomic with respect to other volunteers.
         """
         with self._getwork_lock:
-            return self._get_work_locked(dictcount)
+            work = self._get_work_locked(dictcount)
+        if work is not None:
+            self._m_issued.inc()
+        return work
 
     def _get_work_locked(self, dictcount: int) -> dict:
         dictcount = max(1, min(MAX_DICTCOUNT, int(dictcount)))
@@ -456,7 +470,9 @@ class ServerCore:
         h = hl.parse(net["struct"])
         r = oracle.check_key_m22000(h, [psk], nc=SERVER_NC)
         if not r:
+            self._m_claims.labels(verdict="rejected").inc()
             return False
+        self._m_claims.labels(verdict="accepted").inc()
         psk_b, nc, endian, pmk = r
         self._mark_cracked(net["net_id"], psk_b, pmk, nc or 0, endian or "")
         # replay this PMK against uncracked siblings (common.php:916-932)
@@ -492,6 +508,39 @@ class ServerCore:
                 "SELECT 1 FROM nets WHERE bssid = ? LIMIT 1", (row["bssid"],)
             ):
                 self.db.x("DELETE FROM bssids WHERE bssid = ?", (row["bssid"],))
+
+    # ------------------------------------------------------------------
+    # Telemetry
+    # ------------------------------------------------------------------
+
+    def observe_metrics(self):
+        """Refresh the scrape-time gauges (work-unit lease + net-state
+        stats) in ``self.registry``; called by the ``?metrics`` handler
+        so every scrape reads the live database, not the hourly cron
+        snapshot in the stats table."""
+        reg = self.registry
+        leases = self.db.q1(
+            "SELECT COUNT(*) c, COUNT(DISTINCT hkey) u FROM n2d "
+            "WHERE hkey IS NOT NULL")
+        reg.gauge("dwpa_server_leases_active",
+                  "net x dict coverage rows currently leased"
+                  ).set(leases["c"])
+        reg.gauge("dwpa_server_work_units_in_flight",
+                  "distinct work-unit keys currently leased"
+                  ).set(leases["u"] or 0)
+        oldest = self.db.q1(
+            "SELECT MIN(ts) t FROM n2d WHERE hkey IS NOT NULL")["t"]
+        reg.gauge("dwpa_server_oldest_lease_age_seconds",
+                  "age of the oldest outstanding lease (reaped at "
+                  "dwpa_server_lease_reap_seconds)"
+                  ).set(max(0.0, now() - oldest) if oldest else 0.0)
+        reg.gauge("dwpa_server_lease_reap_seconds",
+                  "stale-lease reap threshold").set(LEASE_REAP_S)
+        for state, label in ((0, "uncracked"), (1, "cracked")):
+            reg.gauge("dwpa_server_nets",
+                      "nets by crack state").labels(state=label).set(
+                self.db.q1("SELECT COUNT(*) c FROM nets WHERE n_state = ?",
+                           (state,))["c"])
 
     # ------------------------------------------------------------------
     # Users & potfile export

@@ -30,8 +30,26 @@ from .core import LEASE_REAP_S, SERVER_NC, ServerCore
 from .db import long2mac
 
 
+def _job_timer(core: ServerCore, job: str):
+    """Span for one cron job, recorded into the core's registry as
+    ``dwpa_span_seconds{span="job:..."}`` — the jobs are pure
+    host/sqlite work (plus oracle verify), so the span needs no device
+    sync."""
+    from ..obs import SpanTracer
+
+    tracer = getattr(core, "_job_tracer", None)
+    if tracer is None:
+        tracer = core._job_tracer = SpanTracer(core.registry)
+    return tracer.span(job)
+
+
 def maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     """Stats + lease reaping + cracked-dict regen; returns the stats."""
+    with _job_timer(core, "job:maintenance"):
+        return _maintenance(core, cracked_dict_path)
+
+
+def _maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     db = core.db
     day_ago = time.time() - 86400
     if cracked_dict_path is None and core.dictdir:
@@ -84,10 +102,14 @@ def maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     # reference's ordering (maint.php computes its counters at 16-32 and
     # reaps at 36) — reaping first would drop just-expired work units out
     # of 24getwork/contributors for the hour they should still count.
-    db.x(
+    reaped = db.x(
         "UPDATE n2d SET hkey = NULL WHERE hkey IS NOT NULL AND ts < ?",
         (time.time() - LEASE_REAP_S,),
-    )
+    ).rowcount
+    if reaped > 0:
+        core.registry.counter(
+            "dwpa_server_leases_reaped_total",
+            "stale work-unit leases reclaimed by maintenance").inc(reaped)
 
     if cracked_dict_path:
         regen_cracked_dict(core, cracked_dict_path)
@@ -186,6 +208,11 @@ def keygen_precompute(core: ServerCore, limit: int = 100,
     """
     if extra_generators is None:
         extra_generators = [vendor_candidates]
+    with _job_timer(core, "job:keygen_precompute"):
+        return _keygen_precompute(core, limit, extra_generators)
+
+
+def _keygen_precompute(core: ServerCore, limit, extra_generators) -> dict:
     db = core.db
     nets = db.q(
         "SELECT * FROM nets WHERE algo IS NULL AND n_state = 0 "

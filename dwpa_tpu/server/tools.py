@@ -27,10 +27,15 @@ import os
 import re
 
 from ..models import hashline as hl
+from ..obs import get_logger
 from ..oracle import m22000 as oracle
 from .capture import extract_hashlines
 from .core import SERVER_NC, ServerCore
 from .db import long2mac
+
+# child of the package logger: one setup_logging() config for every
+# emitter (obs/logs.py), ops warnings included
+_log = get_logger(__name__)
 
 
 class RecrackError(RuntimeError):
@@ -335,9 +340,7 @@ def reorder_captures(core: ServerCore, capdir: str = None) -> dict:
             (dst, src, "%/" + name),
         ).rowcount
     if moved != updated:
-        import logging
-
-        logging.getLogger(__name__).warning(
+        _log.warning(
             "reorder_captures: moved %d files but updated %d submissions "
             "rows — some captures have no (or multiple) DB rows", moved, updated,
         )

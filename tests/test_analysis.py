@@ -347,6 +347,102 @@ def test_dw105_scoped_to_bench_files():
 
 
 # ---------------------------------------------------------------------------
+# DW106: telemetry discipline (obs spans + metric emission)
+# ---------------------------------------------------------------------------
+
+
+def test_dw106_emission_inside_traced_function():
+    vs = lint("""
+        import jax
+
+        def step(x, counter):
+            counter.inc()
+            return x * 2
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW106"]
+    assert "host-side" in vs[0].detail
+
+
+def test_dw106_at_update_is_not_emission():
+    """jnp's functional update x.at[i].set(v) shares the .set name with
+    the gauge API; it is array code and must stay clean."""
+    vs = lint("""
+        import jax
+
+        def step(x):
+            return x.at[0].set(1)
+
+        run = jax.jit(step)
+    """)
+    assert vs == []
+
+
+def test_dw106_unsynced_with_span():
+    src = """
+        import jax.numpy as jnp
+
+        def bench(x, tracer):
+            with tracer.span("hot") as sp:
+                y = jnp.dot(x, x)
+            return y, sp.seconds
+    """
+    vs = lint(src, "bench.py")
+    assert codes(vs) == ["DW106"]
+    assert "never forces completion" in vs[0].detail
+    # span-sync is scoped to the instrumented files
+    assert lint(src, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw106_synced_spans_clean():
+    """The three compliant idioms: engine crack* (syncs internally), an
+    explicit np.asarray fetch, and the API's sync= kwarg."""
+    vs = lint("""
+        import numpy as np
+        import jax.numpy as jnp
+
+        def bench_crack(engine, words, tracer):
+            with tracer.span("crack") as sp:
+                engine.crack(words)
+            return sp.seconds
+
+        def bench_fetch(x, tracer):
+            with tracer.span("dot") as sp:
+                y = np.asarray(jnp.dot(x, x))
+            return sp.seconds
+
+        def bench_kw(x, tracer, y):
+            with tracer.span("dot", sync=lambda: y):
+                y = jnp.dot(x, x)
+    """, "bench.py")
+    assert vs == []
+
+
+def test_dw106_start_stop_pair():
+    vs = lint("""
+        import jax.numpy as jnp
+
+        def bench_pair(x, tracer):
+            sp = tracer.start("hot")
+            y = jnp.dot(x, x)
+            sp.stop()
+            return y
+
+        def bench_pair_ok(engine, words, tracer):
+            sp = tracer.start("hot")
+            engine.crack_batch(words)
+            sp.stop()
+            return sp.seconds
+
+        def thread_lifecycle_ok(t):
+            t.start()
+            t.stop()
+    """, "bench.py")
+    assert codes(vs) == ["DW106"]
+
+
+# ---------------------------------------------------------------------------
 # recompilation sentinel
 # ---------------------------------------------------------------------------
 
@@ -604,7 +700,7 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 
 def test_full_tree_violations_all_known_codes():
-    known = {"DW101", "DW102", "DW103", "DW104", "DW105",
+    known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106",
              "DW201", "DW202", "DW203", "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"

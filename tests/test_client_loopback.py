@@ -20,6 +20,7 @@ from dwpa_tpu import testing as tfx
 from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
 from dwpa_tpu.client.protocol import NoNets, ServerAPI, VersionRejected
 from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.obs import MetricsRegistry
 from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
 
 PSK = b"loopback-psk1"
@@ -82,13 +83,14 @@ def _ingest(core, lines):
     core.db.x("UPDATE nets SET algo = ''")  # release to volunteers
 
 
-def _client(server, tmp_path, **cfg_kw):
+def _client(server, tmp_path, registry=None, **cfg_kw):
     cfg_kw.setdefault("batch_size", 64)
     cfg_kw.setdefault("dictcount", 1)
     cfg = ClientConfig(base_url="http://loopback/",
                        workdir=str(tmp_path / "work"), **cfg_kw)
     api = LoopbackAPI(make_wsgi_app(server))
-    return TpuCrackClient(cfg, api=api, log=lambda *a, **k: None)
+    return TpuCrackClient(cfg, api=api, log=lambda *a, **k: None,
+                          registry=registry)
 
 
 def test_full_round_trip(server, tmp_path):
@@ -179,6 +181,56 @@ def test_resume_rejected_on_batch_size_change(server, tmp_path):
     crashed._write_resume(work)
     same = _client(server, tmp_path, batch_size=64)
     assert same._read_resume() == work
+
+
+def test_metrics_after_one_work_unit(server, tmp_path):
+    """Telemetry contract for one loopback unit (the ISSUE-2 acceptance
+    check): transport counters for get_work/put_work, a nonzero PMK/s
+    gauge, the autotune/dictcount instruments, and well-nested spans."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="mt1")])
+    _add_dict(server, [b"filler-000001", PSK, b"filler-000002"])
+    reg = MetricsRegistry()
+    client = _client(server, tmp_path, registry=reg)
+
+    work = client.api.get_work(client.dictcount)
+    res = client.process_work(work)
+    assert res.accepted
+
+    # transport counters: one get_work, one put_work, one dict download
+    assert reg.value("dwpa_client_requests_total", endpoint="get_work") == 1
+    assert reg.value("dwpa_client_requests_total", endpoint="put_work") == 1
+    assert reg.value("dwpa_client_requests_total",
+                     endpoint="dict_download") == 1
+    # engine throughput: pass 2 carried the dict, so its PMK/s gauge is
+    # live and positive (pass 1 may be too fast to register)
+    assert reg.value("dwpa_client_pmk_per_s", **{"pass": "2"}) > 0
+    # unit accounting + autotune: a sub-second unit tunes dictcount up
+    assert reg.value("dwpa_client_work_units_total", accepted="true") == 1
+    assert reg.value("dwpa_client_founds_total") == 1
+    assert reg.value("dwpa_client_autotune_total", direction="up") == 1
+    assert reg.value("dwpa_client_dictcount") == 2
+    # no resume, no recompile-counter surprises recorded as gauges
+    assert reg.value("dwpa_client_resume_skipped_total") is None
+
+    # spans: the work_unit span parents pass1/pass2/dict_download/
+    # put_work, and every child interval nests inside it
+    recs = client.tracer.records()
+    by_name = {r["name"]: r for r in recs}
+    for name in ("work_unit", "pass1", "pass2", "put_work",
+                 "dict_download", "get_work"):
+        assert name in by_name, (name, sorted(by_name))
+    unit = by_name["work_unit"]
+    for name, parent in (("pass1", "work_unit"), ("pass2", "work_unit"),
+                         ("put_work", "work_unit"),
+                         # the lazy dict fetch fires when pass 2 first
+                         # pulls its stream, so it nests under pass2
+                         ("dict_download", "pass2")):
+        child = by_name[name]
+        assert child["parent"] == parent, child
+        assert unit["t0"] <= child["t0"] <= child["t1"] <= unit["t1"], child
+    assert by_name["get_work"]["parent"] is None
+    # span durations also land in the registry histogram
+    assert reg.value("dwpa_span_seconds", span="work_unit") == 1
 
 
 def test_shard_word_blocks_covers_stream_in_lockstep():

@@ -308,6 +308,100 @@ def test_wsgi_endpoints(core):
     assert json.loads(body)["cracked"] >= 1
 
 
+def _parse_prometheus(text: str) -> dict:
+    """{(name, frozenset(labels)): value} plus {"#types": {name: type}}
+    — a strict little v0.0.4 parser: every non-comment line must be
+    ``name[{labels}] value``."""
+    samples, types = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        metric, _, value = line.rpartition(" ")
+        labels = frozenset()
+        if "{" in metric:
+            name, _, rest = metric.partition("{")
+            body = rest.rstrip("}")
+            labels = frozenset(
+                (kv.split("=", 1)[0], kv.split("=", 1)[1].strip('"'))
+                for kv in body.split(","))
+        else:
+            name = metric
+        samples[(name, labels)] = float(value)
+    return {"samples": samples, "types": types}
+
+
+def test_metrics_endpoint_prometheus_scrape(tmp_path):
+    """?metrics serves parseable Prometheus text-format v0.0.4 with
+    per-endpoint request counters + latency histograms, scheduler
+    counters, and the scrape-time lease gauges (ISSUE-2 acceptance)."""
+    from dwpa_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    core = ServerCore(Database(":memory:"), dictdir=str(tmp_path / "dicts"),
+                      capdir=str(tmp_path / "caps"), registry=reg)
+    app = make_wsgi_app(core)
+
+    core.add_hashlines([tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="mx")])
+    _released(core)
+    _add_dict(core, [PSK])
+    status, body = _call(app, "POST", qs="get_work=2.2.0",
+                         body=json.dumps({"dictcount": 1}).encode())
+    work = json.loads(body)
+    bssid = hl.parse(work["hashes"][0]).mac_ap.hex()
+    _call(app, "POST", qs="put_work", body=json.dumps({
+        "hkey": work["hkey"], "type": "bssid",
+        "cand": [{"k": bssid, "v": PSK.hex()}]}).encode())
+    maintenance(core)
+
+    status, body = _call(app, qs="metrics")
+    assert status.startswith("200")
+    prom = _parse_prometheus(body.decode())
+    s = prom["samples"]
+    assert prom["types"]["dwpa_http_requests_total"] == "counter"
+    assert prom["types"]["dwpa_http_request_seconds"] == "histogram"
+    assert s[("dwpa_http_requests_total",
+              frozenset({("endpoint", "get_work"), ("status", "200")}))] == 1
+    assert s[("dwpa_http_requests_total",
+              frozenset({("endpoint", "put_work"), ("status", "200")}))] == 1
+    # per-endpoint latency histogram: +Inf bucket == count, sum present
+    inf = s[("dwpa_http_request_seconds_bucket",
+             frozenset({("endpoint", "get_work"), ("le", "+Inf")}))]
+    cnt = s[("dwpa_http_request_seconds_count",
+             frozenset({("endpoint", "get_work")}))]
+    assert inf == cnt == 1
+    assert ("dwpa_http_request_seconds_sum",
+            frozenset({("endpoint", "get_work")})) in s
+    # scheduler + claim counters from core.py
+    assert s[("dwpa_server_work_issued_total", frozenset())] == 1
+    assert s[("dwpa_server_claims_total",
+              frozenset({("verdict", "accepted")}))] == 1
+    # scrape-time lease/net gauges (the unit was accepted: lease closed)
+    assert s[("dwpa_server_leases_active", frozenset())] == 0
+    assert s[("dwpa_server_nets", frozenset({("state", "cracked")}))] == 1
+    # maintenance-job duration rode the span histogram
+    assert s[("dwpa_span_seconds_count",
+              frozenset({("span", "job:maintenance")}))] == 1
+
+    # the JSON wire form parses and agrees on the counter
+    status, body = _call(app, qs="metrics=json")
+    snap = json.loads(body)
+    reqs = snap["dwpa_http_requests_total"]["samples"]
+    got = {tuple(sorted(x["labels"].items())): x["value"] for x in reqs}
+    assert got[(("endpoint", "get_work"), ("status", "200"))] == 1
+    # scrapes count themselves (this is the second one)
+    status, body = _call(app, qs="metrics")
+    prom2 = _parse_prometheus(body.decode())
+    assert prom2["samples"][(
+        "dwpa_http_requests_total",
+        frozenset({("endpoint", "metrics"), ("status", "200")}))] == 2
+
+
 def test_put_work_hash_type_raw_digit_psk(core):
     """'hash' claims carry raw-text PSKs: an all-digit key (valid hex!)
     must not be hex-decoded (ADVICE r1; common.php:890-898)."""
