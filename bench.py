@@ -269,6 +269,54 @@ def bench_dict_steady(batch: int, batches: int = 8) -> dict:
             "pmk_per_s": n / dt, "recompiles": comp.count}
 
 
+def bench_feed_overlap(batch: int, batches: int = 8) -> dict:
+    """Candidate-feed pipeline overlap (dwpa_tpu/feed): the dict product
+    path with host packing moved onto producer threads and H2D staged
+    double-buffered — the input-pipeline shape ISSUE 3 built.
+
+    Reports PMK/s next to the STARVE FRACTION: the share of the region's
+    wall-clock the consumer spent blocked on an empty feed queue
+    (``dwpa_feed_consumer_starve_seconds`` over the span).  ~0 means the
+    host pipeline keeps the mesh fed (the feed's point); a fraction
+    approaching the gap to mask_pbkdf2 means the host stages are the
+    bottleneck — scale --feed-workers or the native packer, not the
+    device.  The stall fraction is the mirror (producers blocked on a
+    full queue = device-bound, the healthy state).  An isolated registry
+    keeps this run's histograms out of the process-wide scrape numbers.
+    """
+    from dwpa_tpu.feed import CandidateFeed
+    from dwpa_tpu.obs import MetricsRegistry
+
+    engine = M22000Engine(
+        [T.make_pmkid_line(b"feedpass77", b"bench-feed", seed="fo")],
+        batch_size=batch,
+    )
+    engine.crack_batch([b"warm-%07d" % i for i in range(batch)])
+    n = batches * batch
+    reg = MetricsRegistry()
+    feed = CandidateFeed((b"feed-%08d" % i for i in range(n)),
+                         batch_size=batch, depth=2, producers=1,
+                         prepack=engine.host_packer(), registry=reg,
+                         name="bench")
+    with watch_compiles() as comp:
+        with TRACER.span("bench:feed_overlap") as sp:
+            engine.crack_blocks(feed)
+        dt = sp.seconds
+    feed.close()
+    snap = reg.snapshot()
+
+    def _hist(nm):
+        s = snap.get(nm, {}).get("samples") or [{}]
+        return float(s[0].get("sum", 0.0))
+
+    starve = _hist("dwpa_feed_consumer_starve_seconds")
+    stall = _hist("dwpa_feed_producer_stall_seconds")
+    return {"label": "feed_overlap", "words": n, "seconds": dt,
+            "pmk_per_s": n / dt,
+            "starve_fraction": starve / dt, "stall_fraction": stall / dt,
+            "queue_depth": 2, "producers": 1, "recompiles": comp.count}
+
+
 def _timed(fn, name: str = "bench:timed") -> float:
     """One rep as a span: the body must sync its own device work (every
     caller passes an engine crack* call, which does)."""
@@ -380,6 +428,7 @@ def main():
     multi = bench_multi_bssid(words)
     steady = bench_dict_steady(batch)
     feed = bench_host_feed()
+    feed_ov = bench_feed_overlap(batch)
     overhead = bench_unit_overhead(pmkid)
 
     value = mask["pmk_per_s"]
@@ -401,6 +450,7 @@ def main():
                     "multi_bssid": _round(multi),
                     "dict_steady": _round(steady),
                     "host_feed": _round(feed),
+                    "feed_overlap": _round(feed_ov),
                     "unit_overhead": _round(overhead),
                 },
             }

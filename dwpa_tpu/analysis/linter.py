@@ -37,6 +37,19 @@ Rule codes (stable — referenced by baseline.json and the docs):
   which sync internally) before the clock stops.  On the tunnelled TPU
   dispatch returns early, so such a span overstates throughput by
   orders of magnitude (see bench.py's timing notes).
+- **DW107 feed-thread-discipline** — the candidate-feed contract
+  (``dwpa_tpu/feed``), two shapes: (a) a blocking synchronization call
+  (``queue.get``/``queue.put``/``join``/``acquire``/``wait`` on a
+  queue/lock/event-named receiver) inside a function under a JAX trace
+  — a traced region that blocks on host synchronization either fails
+  on a tracer or, worse, bakes a one-time value into the compiled
+  program while serializing the pipeline it was supposed to overlap;
+  (b) a feed producer function (``*produce*`` in ``dwpa_tpu/feed/``)
+  touching a jax/jnp device API other than ``device_put``/
+  ``shard_candidates`` — producer threads run pure host stages; any
+  other device call from a thread races the consumer's dispatch order
+  (fatal on a multi-process mesh, where enqueue order is a collective
+  contract).
 - **DW106 telemetry-discipline** — the obs-layer contract, two shapes:
   (a) a metric/span emission call (``.inc()``/``.dec()``/``.set()``/
   ``.observe()``, excluding jnp's ``x.at[i].set(v)`` functional update)
@@ -57,6 +70,7 @@ individually-accepted sync or compile.
 import ast
 import dataclasses
 import os
+import re
 
 #: files whose host↔device syncs DW104 polices (repo-relative, posix)
 HOT_PATH_FILES = ("dwpa_tpu/parallel/step.py", "dwpa_tpu/models/m22000.py")
@@ -70,6 +84,16 @@ SPAN_FILES = ("bench.py", "dwpa_tpu/client/main.py")
 
 #: metric-emission methods DW106 bans inside traced functions
 OBS_EMIT_METHODS = {"inc", "dec", "observe", "set"}
+
+#: directories whose producer-thread discipline DW107(b) polices
+FEED_DIRS = ("dwpa_tpu/feed",)
+#: jax calls a feed producer thread MAY make (H2D staging only)
+FEED_PRODUCER_ALLOWED = {"device_put", "shard_candidates"}
+#: blocking-sync methods DW107(a) bans inside traced regions, and the
+#: receiver names that mark the call as a queue/lock primitive (so
+#: ``cfg.get(...)``/``", ".join(...)``/``os.path.join`` stay clean)
+BLOCKING_SYNC_METHODS = {"get", "put", "join", "acquire", "wait"}
+_BLOCKING_RECV = re.compile(r"(?i)(queue|lock|sem|cond|cv|event|^q|_q)$")
 
 #: callables that put their function argument under a JAX trace
 TRACE_ENTRYPOINTS = {
@@ -94,7 +118,7 @@ _BAD_DTYPES = {
 #: internally, like the engine's crack loop via its hits gate)
 SYNC_MARKERS = {
     "block_until_ready", "asarray", "item", "array",
-    "crack", "crack_batch", "crack_rules", "crack_mask",
+    "crack", "crack_batch", "crack_rules", "crack_mask", "crack_blocks",
 }
 
 
@@ -368,6 +392,17 @@ def _check_traced_function(fn, how, static_names, static_nums, path,
                     f"function ({how}) — telemetry is host-side only; "
                     "record after the device call returns",
                     _line(src_lines, node)))
+            elif (name in BLOCKING_SYNC_METHODS
+                    and isinstance(node.func, ast.Attribute)
+                    and _BLOCKING_RECV.search(_recv_name(node.func))):
+                out.append(Violation(
+                    "DW107", path, node.lineno,
+                    f"blocking .{name}() on "
+                    f"'{_recv_name(node.func)}' inside traced function "
+                    f"({how}) — queue/lock waits are host-side; a trace "
+                    "either fails on it or bakes a one-time value in "
+                    "while serializing the pipeline",
+                    _line(src_lines, node)))
 
 
 def _is_at_update(f: ast.Attribute) -> bool:
@@ -375,6 +410,47 @@ def _is_at_update(f: ast.Attribute) -> bool:
     base) is array code, not telemetry — exempt from the DW106
     emission check."""
     return any(isinstance(n, ast.Subscript) for n in ast.walk(f.value))
+
+
+def _recv_name(f: ast.Attribute) -> str:
+    """Last identifier of a method call's receiver (``self._queue.get``
+    -> ``_queue``; ``q.get`` -> ``q``; constants/calls -> "")."""
+    base = f.value
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# DW107(b): feed producer thread discipline
+# ---------------------------------------------------------------------------
+
+
+def _check_feed_producers(tree, path, src_lines, out):
+    """In ``dwpa_tpu/feed/``: a producer function (name contains
+    "produce" — the subsystem's documented naming convention for code
+    that runs on producer threads) may touch NO jax/jnp/lax call beyond
+    the allowed H2D staging pair."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if "produce" not in fn.name:
+            continue
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and _is_jaxlike_call(node)):
+                continue
+            name = _call_name(node)
+            if name in FEED_PRODUCER_ALLOWED:
+                continue
+            out.append(Violation(
+                "DW107", path, node.lineno,
+                f"feed producer {fn.name}() calls jax device API "
+                f"'{name}' — producer threads are pure host stages; "
+                "only device_put/shard_candidates (H2D staging) are "
+                "allowed off the consumer thread",
+                _line(src_lines, node)))
 
 
 # ---------------------------------------------------------------------------
@@ -661,6 +737,8 @@ def lint_source(src: str, path: str) -> list:
         _check_timed_sections(tree, path, src_lines, out)
     if path in SPAN_FILES:
         _check_span_sync(tree, path, src_lines, out)
+    if path.startswith(tuple(d + "/" for d in FEED_DIRS)):
+        _check_feed_producers(tree, path, src_lines, out)
     return out
 
 

@@ -29,6 +29,13 @@ def build_parser():
                    help="expand PASS-1 rules (cracked/rkg dicts) in N "
                         "worker processes; pass 2 mangles on device "
                         "(0 = inline)")
+    p.add_argument("--feed-depth", type=int, default=2,
+                   help="candidate-feed queue depth: blocks framed/packed "
+                        "ahead of the engine (README 'Candidate feed')")
+    p.add_argument("--feed-workers", type=int, default=1,
+                   help="candidate-feed producer threads running the host "
+                        "stages off the crack loop (0 = inline feed, no "
+                        "threads)")
     p.add_argument("--multihost", action="store_true",
                    help="join a jax.distributed slice before any engine "
                         "work (TPU pod environment auto-detected); the "
@@ -72,6 +79,8 @@ def main(argv=None):
         max_work_units=args.max_work_units,
         nc=args.nc,
         rule_workers=args.rule_workers,
+        feed_depth=args.feed_depth,
+        feed_workers=args.feed_workers,
     )
     TpuCrackClient(cfg).run()
 

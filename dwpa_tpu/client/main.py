@@ -30,6 +30,8 @@ from dataclasses import dataclass, field
 import jax
 
 from ..analysis import watch_compiles
+from ..feed import CandidateFeed
+from ..feed.framing import frame_blocks
 from ..gen import DictStream, psk_candidates
 from ..models import hashline as hl
 from ..models.m22000 import M22000Engine
@@ -102,20 +104,19 @@ def shard_word_blocks(words, nproc: int, pid: int, batch_size: int,
     ``blk = ceil(len(block)/nproc)`` slice of each ``batch_size * nproc``
     block, and pads short slices with an invalid word so EVERY host feeds
     the engine the same number of same-sized batches — the SPMD-lockstep
-    contract ``M22000Engine.crack`` requires (an unpadded empty tail
-    slice would desync the shard_map collectives).  ``global_count`` is
-    the number of real global candidates the block covers, so resume
+    contract ``M22000Engine.crack`` requires.  ``global_count`` is the
+    number of real global candidates the block covers, so resume
     checkpoints keep counting stream positions, not local shard rows.
+
+    Kept for API compat: the framing itself now lives in
+    ``dwpa_tpu.feed.framing.frame_blocks``, which emits the IDENTICAL
+    ``(mine, global_count)`` sequence but buffers only the words that
+    can land in this host's slice instead of materializing the full
+    ``batch_size * nproc`` global block on every host.
     """
-    words = iter(words)
-    while True:
-        block = list(itertools.islice(words, batch_size * nproc))
-        if not block:
-            return
-        blk = min(batch_size, -(-len(block) // nproc))
-        mine = block[pid * blk:(pid + 1) * blk]
-        mine += [pad_word] * (blk - len(mine))
-        yield mine, len(block)
+    for blk in frame_blocks(words, batch_size, nproc=nproc, pid=pid,
+                            pad_word=pad_word):
+        yield blk.words, blk.count
 
 
 def version_tuple(v: str):
@@ -145,6 +146,10 @@ class ClientConfig:
     rule_workers: int = 0           # >1: expand PASS-1 rules (cracked/rkg
                                     # dicts) in a process pool; pass 2
                                     # mangles on device (0 = inline)
+    feed_depth: int = 2             # candidate-feed queue depth (blocks
+                                    # framed ahead of the engine)
+    feed_workers: int = 1           # candidate-feed producer threads
+                                    # (0 = inline/synchronous feed)
     archive: bool = True            # append-only archive.22000/archive.res
                                     # audit logs (DAW, help_crack.py:453-456)
 
@@ -309,10 +314,24 @@ class TpuCrackClient:
             nc=self.cfg.nc, batch_size=self.cfg.batch_size,
         )
         n = eng.batch_size
-        eng.crack_batch([b"warm-%08d" % i for i in range(n)])
-        eng.crack_batch([b"warm-long-padding-%08d" % i for i in range(n)])
-        eng.crack_batch([b"warm-full-width-passphrase-padding-%08d" % i
-                         for i in range(n)])
+        # The three width buckets stream through the candidate feed —
+        # one block per bucket — so prewarm also exercises (and warms)
+        # the exact feed -> stage -> dispatch path real units take.
+        warm_words = itertools.chain(
+            (b"warm-%08d" % i for i in range(n)),
+            (b"warm-long-padding-%08d" % i for i in range(n)),
+            (b"warm-full-width-passphrase-padding-%08d" % i
+             for i in range(n)),
+        )
+        feed = CandidateFeed(warm_words, batch_size=n,
+                             depth=self.cfg.feed_depth,
+                             producers=self.cfg.feed_workers,
+                             prepack=eng.host_packer(),
+                             registry=self.registry, name="prewarm")
+        try:
+            eng.crack_blocks(feed)
+        finally:
+            feed.close()
         if jax.process_count() == 1:
             # Pass 2 runs through the fused device-rules step now; warm
             # both interpreter step buckets so a first unit carrying
@@ -405,7 +424,28 @@ class TpuCrackClient:
 
     def _cracked_candidates(self, work: dict, rules):
         """Pass-1 stream of the server's cracked + rkg dictionaries,
-        expanded through the work rules.
+        expanded through the work rules (compat wrapper: prefetch +
+        stream — ``_process_work`` calls the two halves separately so
+        the downloads and the multi-host digest agreement stay on the
+        consumer thread while the streaming runs on feed producers)."""
+        files = None
+
+        def deferred():
+            nonlocal files
+            if files is None:  # first pull: fetch, then stream
+                files = self._prefetch_cracked(work)
+            yield from self._stream_cracked(files, rules)
+
+        return deferred()
+
+    def _prefetch_cracked(self, work: dict) -> list:
+        """Download/refresh the cracked + rkg snapshots and agree on
+        their digests across the slice; returns the local file list.
+
+        CONSUMER-THREAD ONLY (server calls + a collective): feed
+        producer threads stream the returned files via
+        ``_stream_cracked`` but must never fetch (lint rule DW107's
+        discipline — collectives off the producer threads).
 
         DAW behavior (help_crack.py:469-509,512-529): when a work unit
         carries cracked.txt.gz, keep a local copy refreshed only every
@@ -420,7 +460,7 @@ class TpuCrackClient:
             None,
         )
         if entry is None:
-            return
+            return []
         cracked = os.path.join(self.dictdir, "cracked.txt.gz")
         rkg = os.path.join(self.dictdir, "rkg.txt.gz")
         # The cadence refresh is suppressed while replaying a resumed
@@ -458,10 +498,49 @@ class TpuCrackClient:
                     "multi-host pass-1 dict snapshot mismatch (cracked/rkg "
                     "raced a server regen) — delete the local copies and "
                     f"restart the unit; digests: {alld}")
+        return files
+
+    def _stream_cracked(self, files: list, rules):
+        """Stream the prefetched cracked/rkg files through the work
+        rules — pure host work, safe on a feed producer thread."""
         for path in files:
             stream = DictStream(path)
             yield from (apply_rules(rules, stream, workers=self.cfg.rule_workers)
                         if rules else stream)
+
+    def _snapshot_prdict(self, work: dict):
+        """Snapshot the dynamic PR dict into the work/resume state.
+
+        CONSUMER-THREAD ONLY, hoisted ahead of the pass-1 feed: the
+        server query, the multi-host broadcast AND the resume write must
+        not run on a producer thread (collectives would race the
+        engine's shard_map enqueue order across hosts, and two threads
+        must never mutate/serialize the shared ``work`` dict).
+
+        The server-side query is unordered and grows with new
+        submissions, so re-fetching after a crash would misalign the
+        resume's skip-by-count fast-forward; the snapshot rides every
+        checkpoint write, making the stream deterministic.  Multi-host:
+        only process 0 queries (the unordered result MUST be
+        byte-identical on every host or the pass-1 stream lengths
+        diverge and the shard_map collectives desync).
+        """
+        if not work.get("prdict") or "_prdict_cache" in work:
+            return
+        hexes = None
+        if jax.process_index() == 0:
+            try:
+                words = self.api.get_prdict(work["hkey"])
+            except (ConnectionError, ValueError, OSError):
+                # OSError covers gzip.BadGzipFile etc.; a host-0 raise
+                # here would strand the peers already parked in the
+                # broadcast below
+                words = []
+            hexes = [w.hex() for w in words]
+        if jax.process_count() > 1:
+            hexes = _broadcast_json(hexes) or []
+        work["_prdict_cache"] = hexes
+        self._write_resume(work)
 
     def _rules(self, work: dict):
         blob = work.get("rules")
@@ -496,32 +575,12 @@ class TpuCrackClient:
         yield from targeted_candidates(essids)
         for h in parsed:
             yield from psk_candidates(h.essid, h.mac_ap, h.mac_sta)
-        if work.get("prdict"):
-            # Snapshot the dynamic PR dict into the work/resume state: the
-            # server-side query is unordered and grows with new
-            # submissions, so re-fetching after a crash would misalign the
-            # resume's skip-by-count fast-forward.  The snapshot rides
-            # every checkpoint write, making the stream deterministic.
-            # Multi-host: only process 0 queries (the unordered result
-            # MUST be byte-identical on every host or the pass-1 stream
-            # lengths diverge and the shard_map collectives desync).
-            if "_prdict_cache" not in work:
-                hexes = None
-                if jax.process_index() == 0:
-                    try:
-                        words = self.api.get_prdict(work["hkey"])
-                    except (ConnectionError, ValueError, OSError):
-                        # OSError covers gzip.BadGzipFile etc.; a host-0
-                        # raise here would strand the peers already
-                        # parked in the broadcast below
-                        words = []
-                    hexes = [w.hex() for w in words]
-                if jax.process_count() > 1:
-                    hexes = _broadcast_json(hexes) or []
-                work["_prdict_cache"] = hexes
-                self._write_resume(work)
-            for wx in work["_prdict_cache"]:
-                yield oracle.hc_unhex(bytes.fromhex(wx))
+        # The dynamic PR dict reads ONLY the snapshot ``_snapshot_prdict``
+        # hoisted into the work state before the feed started — this
+        # generator runs on a producer thread and must stay pure host
+        # work (no server calls, no collectives, no resume writes).
+        for wx in work.get("_prdict_cache") or []:
+            yield oracle.hc_unhex(bytes.fromhex(wx))
         if self.cfg.additional_dict:
             yield from DictStream(self.cfg.additional_dict)
 
@@ -546,24 +605,27 @@ class TpuCrackClient:
 
     # -- the loop ----------------------------------------------------------
 
-    def _pass1_candidates(self, engine: M22000Engine, work: dict, rules):
+    def _pass1_candidates(self, work: dict, rules, cracked_files: list):
         """Pass-1 deterministic host-side stream: targeted generators,
         then cracked/rkg through the work rules (highest-yield first,
-        help_crack.py:615-687)."""
-        yield from self._targeted_candidates(engine, work)
-        yield from self._cracked_candidates(work, rules)
+        help_crack.py:615-687).  Pure host work — runs on the feed's
+        producer threads; every server call/collective was hoisted
+        (``_snapshot_prdict`` / ``_prefetch_cracked``)."""
+        yield from self._targeted_candidates(None, work)
+        yield from self._stream_cracked(cracked_files, rules)
 
-    def _pass2_words(self, work: dict):
-        """Pass-2 BASE words: the remaining server dicts, in work-unit
-        order.  Downloads happen lazily when the stream reaches a dict,
-        so a resume skipping pass 1 still fetches them.
+    def _fetch_pass2_paths(self, work: dict) -> list:
+        """Fetch the pass-2 server dicts; returns local paths.
 
-        Multi-host: a download failure on ONE host (e.g. the md5 gate
-        tripping because the server regenerated a dict between two
-        hosts' fetches) must abort the whole slice loudly — every host
-        reaches the allgather below even on failure, then all raise
-        together instead of one host crashing out of the stream while
-        its peers block in the crack collectives."""
+        CONSUMER-THREAD ONLY, at pass-2 start (a resume that skipped
+        pass 1 still fetches here; the feed's producers then stream
+        pure file reads).  Multi-host: a download failure on ONE host
+        (e.g. the md5 gate tripping because the server regenerated a
+        dict between two hosts' fetches) must abort the whole slice
+        loudly — every host reaches the allgather below even on
+        failure, then all raise together instead of one host crashing
+        out of the stream while its peers block in the crack
+        collectives."""
         err = None
         try:
             paths = self._fetch_dicts(work)
@@ -576,8 +638,7 @@ class TpuCrackClient:
             if errs:
                 raise RuntimeError(
                     f"pass-2 dict fetch failed on the slice: {errs}")
-        for path in paths:
-            yield from DictStream(path)
+        return paths
 
     def process_work(self, work: dict) -> WorkResult:
         """One work unit, traced end to end: the ``work_unit`` span
@@ -635,75 +696,99 @@ class TpuCrackClient:
             }
             self._write_resume(work)
 
-        # Pass 1 materializes host-side, so its resume fast-forward is a
-        # plain islice; whatever the window doesn't cover carries into
-        # pass 2.  Pass-2 rules run ON DEVICE (crack_rules: one base-word
-        # upload mangled by every rule — the hashcat-on-GPU analog of
-        # help_crack.py:773's ``-S -r``), where candidates never exist
-        # host-side; crack_rules' own skip honors the same count contract.
+        # Pass 1 materializes host-side, so its resume fast-forward is
+        # the feed's producer-side skip; whatever the window doesn't
+        # cover carries into pass 2.  Pass-2 rules run ON DEVICE
+        # (crack_rules: one base-word upload mangled by every rule — the
+        # hashcat-on-GPU analog of help_crack.py:773's ``-S -r``), where
+        # candidates never exist host-side; crack_rules' own skip honors
+        # the same count contract.
+        #
+        # Both passes consume from the candidate feed (dwpa_tpu/feed):
+        # producer threads run the host stages (streaming, rule
+        # expansion, $HEX decode + packing) behind a bounded block
+        # queue, so the mesh never idles on host work — every server
+        # call, collective and resume write is hoisted onto this
+        # (consumer) thread first, the producer-thread discipline lint
+        # rule DW107 documents.
         rules = self._rules(work)
+        cfg_feed = dict(depth=self.cfg.feed_depth,
+                        producers=self.cfg.feed_workers,
+                        registry=self.registry)
+        self._snapshot_prdict(work)
         # The compile sentinel wraps both passes: a steady-state unit
         # must not pay XLA time (prewarm covered the shapes), and when
         # one does, the counter makes it visible fleet-wide instead of
         # showing up only as a mysteriously slow unit.
         with watch_compiles() as comp:
             with self.tracer.span("pass1") as sp1:
-                stream1 = iter(self._pass1_candidates(engine, work, rules))
-                skipped = 0
+                cracked_files = self._prefetch_cracked(work)
                 if skip:
                     self.log(f"resuming work unit at candidate {skip}")
-                    skipped = sum(1 for _ in itertools.islice(stream1, skip))
-                engine.crack(stream1, on_batch=on_batch)
-            # engine.crack syncs internally (hits gate), so sp1's clock
-            # stopped after real device completion; the gauge counts
-            # candidates/s — PMKs computed per candidate per essid group
+                feed1 = CandidateFeed(
+                    self._pass1_candidates(work, rules, cracked_files),
+                    batch_size=self.cfg.batch_size, skip=skip, nproc=1,
+                    pid=0, prepack=engine.host_packer(), name="pass1",
+                    **cfg_feed)
+                try:
+                    engine.crack_blocks(feed1, on_batch=on_batch)
+                    # actually-skipped count (< skip on a short stream);
+                    # the remainder of the resume window carries into
+                    # pass 2.  The skip ran before any framing, so this
+                    # never blocks on device work.
+                    skipped = feed1.skipped
+                finally:
+                    feed1.close()
+            # engine crack_blocks syncs internally (hits gate), so sp1's
+            # clock stopped after real device completion; the gauge
+            # counts candidates/s — PMKs computed per candidate per
+            # essid group
             tried1 = done - skip
             if tried1 and sp1.seconds > 0:
                 self._m_pmks.labels(**{"pass": "1"}).set(tried1 / sp1.seconds)
             skip2 = skip - skipped
             with self.tracer.span("pass2") as sp2:
-                words = self._pass2_words(work)
+                paths = self._fetch_pass2_paths(work)
+                words = (w for p in paths for w in DictStream(p))
                 if rules:
                     # Single- AND multi-process: crack_rules takes the
                     # full global dict stream (every host downloads whole
                     # dicts anyway) and shards internally — each host
                     # uploads only its 1/nproc row slice and decodes
                     # finds from the replicated bitmask, so no host ever
-                    # feeds expanded candidates.
-                    engine.crack_rules(words, rules, on_batch=on_batch,
-                                       skip=skip2)
-                elif jax.process_count() > 1:
-                    # No-rules pass 2 shards too (it used to run
-                    # replicated — nproc× redundant PBKDF2 on the bulk of
-                    # the unit): each host feeds its block slice of the
-                    # global stream, padded so batch counts stay in SPMD
-                    # lockstep, and the checkpoint counter keeps counting
-                    # GLOBAL stream positions (the resume skip below is
-                    # applied to the global stream, so the two must agree
-                    # or a resume would skip untried candidates).
-                    for _ in itertools.islice(words, skip2):
-                        pass
-                    blocks = shard_word_blocks(words, jax.process_count(),
-                                               jax.process_index(),
-                                               self.cfg.batch_size)
-                    global_counts = []
-
-                    def local_words():
-                        for mine, gcount in blocks:
-                            global_counts.append(gcount)
-                            yield from mine
-
-                    def on_block(consumed, new_founds):
-                        # one engine batch per block, in stream order —
-                        # report the block's global coverage, not the
-                        # local shard rows
-                        on_batch(global_counts.pop(0), new_founds)
-
-                    engine.crack(local_words(), on_batch=on_block)
+                    # feeds expanded candidates.  The feed supplies the
+                    # base words (``words()`` flat view): dict read +
+                    # gunzip move to the producer threads while
+                    # crack_rules owns framing, packing and skip.
+                    feed2 = CandidateFeed(
+                        words, nproc=1, pid=0, prepack=None, name="pass2",
+                        batch_size=self.cfg.batch_size * jax.process_count(),
+                        **cfg_feed)
+                    try:
+                        engine.crack_rules(feed2.words(), rules,
+                                           on_batch=on_batch, skip=skip2)
+                    finally:
+                        feed2.close()
                 else:
-                    for _ in itertools.islice(words, skip2):
-                        pass
-                    engine.crack(words, on_batch=on_batch)
+                    # No-rules pass 2 shards across hosts (it used to
+                    # run replicated — nproc× redundant PBKDF2 on the
+                    # bulk of the unit): the feed's sharded framing
+                    # hands each host its padded 1/nproc block slice of
+                    # the global stream (an empty shard arrives as an
+                    # all-padding block, keeping SPMD lockstep), the
+                    # resume skip applies to the GLOBAL stream on the
+                    # producer, and crack_blocks reports each block's
+                    # global count so the checkpoint keeps counting
+                    # stream positions.  Single-process degenerates to
+                    # nproc=1 framing — one code path for both.
+                    feed2 = CandidateFeed(
+                        words, batch_size=self.cfg.batch_size, skip=skip2,
+                        prepack=engine.host_packer(), name="pass2",
+                        **cfg_feed)
+                    try:
+                        engine.crack_blocks(feed2, on_batch=on_batch)
+                    finally:
+                        feed2.close()
         tried = done - skip
         tried2 = tried - tried1
         if tried2 and sp2.seconds > 0:
@@ -714,8 +799,13 @@ class TpuCrackClient:
         elapsed = time.perf_counter() - t0
         st = engine.stage_times
         crack_s = sum(st.values())
+        # "prepare" is the RESIDUAL on-thread stage time (device staging
+        # for feed-prepacked blocks): packing itself runs on the feed's
+        # producer threads and is accounted to the feed:produce spans —
+        # the dict keys stay as-is for API compat (M22000Engine
+        # stage_times comment).
         self.log(
-            "stages: pack+h2d=%.1fs dispatch=%.1fs device+sync=%.1fs "
+            "stages: stage+h2d=%.1fs dispatch=%.1fs device+sync=%.1fs "
             "other=%.1fs (tried %d)"
             % (st["prepare"], st["dispatch"], st["collect"],
                max(0.0, elapsed - crack_s), tried)

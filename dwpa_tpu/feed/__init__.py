@@ -1,0 +1,28 @@
+"""dwpa_tpu.feed — the pipelined candidate-feed subsystem.
+
+Overlaps host candidate production with device compute, the input
+pipeline the ROADMAP's "as fast as the hardware allows" north star
+calls for:
+
+- :mod:`.framing` — deterministic ``(global_offset, count)`` block
+  framing (single-host and multi-host shard slicing; the resume-gate
+  and SPMD-lockstep contracts live here);
+- :mod:`.pipeline` — ``CandidateFeed``: bounded block queue filled by
+  producer threads running the host stages (dict streaming, rule
+  expansion, ``$HEX`` decode + native packing), with backpressure,
+  fault-with-offset delivery, and ``dwpa_feed_*`` telemetry;
+- :mod:`.staging` — ``DeviceStager``: double-buffered ``shard_candidates``
+  H2D, enqueueing block N+1's upload while block N's steps execute.
+
+Consumed by ``M22000Engine.crack_blocks`` and wired through the client
+(pass 1, both pass-2 paths, prewarm) and ``bench:feed_overlap``.
+"""
+
+from .framing import Block, frame_blocks, skip_stream
+from .pipeline import CandidateFeed, FeedError
+from .staging import DeviceStager
+
+__all__ = [
+    "Block", "frame_blocks", "skip_stream",
+    "CandidateFeed", "FeedError", "DeviceStager",
+]

@@ -1,0 +1,123 @@
+"""Deterministic candidate-block framing for the feed subsystem.
+
+Every block of the candidate pipeline carries ``(offset, count)`` — the
+GLOBAL stream position of its first candidate and the number of global
+candidates it covers — so the client's resume gate (skip-by-count
+against ``_write_resume``'s ``_batch``/mesh/version stamps) and the
+multi-host lockstep contract keep working unchanged when production
+moves onto background threads: the framing is a pure function of the
+source stream and the ``(batch_size, nproc, pid)`` geometry, never of
+producer/consumer timing.
+
+Multi-host framing preserves the exact slicing contract of the former
+``client.main.shard_word_blocks`` (which now delegates here): per
+global block of up to ``batch_size * nproc`` words,
+``blk = min(batch_size, ceil(n / nproc))`` and this host's slice is
+``block[pid * blk:(pid + 1) * blk]`` padded to ``blk`` with an invalid
+word — every host emits the SAME number of same-shaped blocks (the
+SPMD-lockstep requirement of ``M22000Engine.crack``), and an empty
+shard becomes an all-padding block (``Block.padded``) rather than an
+absent one.
+
+Unlike the old slicer, a host no longer materializes the full
+``batch_size * nproc`` global block: only words whose index can still
+fall inside this host's slice are buffered.  Because
+``blk(n) = min(batch_size, ceil(n / nproc))`` is nondecreasing in the
+final block length ``n``, the slice window only ever moves right as the
+block grows — so a word at block-index ``i`` is kept iff
+``pid * blk(i + 1) <= i < (pid + 1) * batch_size``, and the buffer's
+left edge is pruned to ``pid * blk(c)`` as the consumed count ``c``
+grows.  Peak buffering is ``(pid + 1) * batch_size - pid * blk(c)``
+(<= ``(pid + 1)(nproc - pid)/nproc * batch_size``, exactly
+``batch_size`` for full blocks and for host 0) versus the former
+``batch_size * nproc`` on every host.
+"""
+
+import itertools
+from collections import deque
+from dataclasses import dataclass
+
+
+@dataclass
+class Block:
+    """One framed candidate block.
+
+    ``offset``/``count`` index the GLOBAL source stream (resume
+    checkpoints advance by ``count``); ``words`` is this host's local
+    slice (equal to the global block when ``nproc == 1``).  ``prep``
+    is filled by a prepacking producer (see ``CandidateFeed``):
+    ``(rows uint32[cap, 16], lens uint8[nvalid], nvalid)`` — the
+    host-packed form ``M22000Engine._prepare_staged`` stages to the
+    device without re-packing.  ``padded`` marks an all-padding block
+    (this host's shard of the global block was empty — dispatched
+    anyway to keep the slice in lockstep, see ``_padding_prep``).
+    """
+
+    offset: int
+    count: int
+    words: list
+    prep: tuple = None
+    padded: bool = False
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(w) for w in self.words)
+
+
+def _blk(n: int, batch_size: int, nproc: int) -> int:
+    """Per-host slice width for a global block of ``n`` words."""
+    return min(batch_size, -(-n // nproc))
+
+
+def frame_blocks(words, batch_size: int, nproc: int = 1, pid: int = 0,
+                 pad_word: bytes = b"", base_offset: int = 0,
+                 watermark: list = None):
+    """Frame a global word stream into deterministic ``Block``s,
+    materializing only this host's 1/nproc shard slice (module
+    docstring has the exact contract and the buffering bound).
+
+    ``base_offset`` seeds the global offset (a resume fast-forward that
+    already consumed ``skip`` words passes ``skip``).  ``watermark``
+    (tests only) receives each block's peak buffer size.
+    """
+    it = iter(words)
+    gsize = batch_size * nproc
+    hi = (pid + 1) * batch_size
+    offset = base_offset
+    while True:
+        buf = deque()  # (block-index, word), indices strictly increasing
+        peak = c = 0
+        for w in it:
+            i = c
+            c += 1
+            if i < hi and pid * _blk(i + 1, batch_size, nproc) <= i:
+                buf.append((i, w))
+            # prune the left edge: the final window start can only grow
+            start = pid * _blk(c, batch_size, nproc)
+            while buf and buf[0][0] < start:
+                buf.popleft()
+            peak = max(peak, len(buf))
+            if c == gsize:
+                break
+        if watermark is not None and c:
+            watermark.append(peak)
+        if c == 0:
+            return
+        blk = _blk(c, batch_size, nproc)
+        start = pid * blk
+        mine = [w for i, w in buf if i < start + blk]
+        nreal = len(mine)
+        mine += [pad_word] * (blk - nreal)
+        yield Block(offset=offset, count=c, words=mine, padded=(nreal == 0))
+        offset += c
+        if c < gsize:
+            return
+
+
+def skip_stream(words, skip: int):
+    """Resume fast-forward: consume up to ``skip`` words; returns how
+    many were actually skipped (< ``skip`` on a short stream) — the
+    count the client folds into its pass accounting."""
+    if skip <= 0:
+        return 0
+    return sum(1 for _ in itertools.islice(iter(words), skip))

@@ -1,0 +1,55 @@
+"""Double-buffered device staging over a framed candidate-block stream.
+
+``M22000Engine._prepare``'s docstring has always hinted that its async
+``device_put`` overlaps the previous batch's compute; ``DeviceStager``
+formalizes that overlap as a component: it pulls block N+1 from the
+feed and enqueues its H2D (``engine._prepare_block`` →
+``shard_candidates``, an async transfer) BEFORE handing block N to the
+caller for dispatch — so at every yield the next block's candidate
+upload is already in flight behind the current block's device steps.
+
+The stager composes with the engine's ``_Pipeline`` (which trails the
+hits-gate sync ``PIPELINE_DEPTH`` batches behind dispatch): the
+pipeline hides the device->host gate latency, the stager hides the
+host->device candidate upload, and the feed's producer threads hide
+the packing — the three layers of the input pipeline every
+training/inference stack grows, here for candidates instead of
+examples.
+
+Stream-order and lockstep contracts are untouched: blocks are staged
+and yielded strictly in feed order, and a block is staged exactly once
+(a multi-process mesh sees the same ``shard_candidates`` sequence it
+would without the stager, just earlier).
+"""
+
+from collections import deque
+
+
+class DeviceStager:
+    """Yield ``(block, prep)`` with ``depth`` blocks' H2D staged ahead.
+
+    ``depth=1`` is classic double buffering: one staged block in flight
+    beyond the one being dispatched.  ``prep`` is the engine's prepared
+    triple (or None for a single-process block with no valid words —
+    the caller skips it but still reports its ``count``).
+    """
+
+    def __init__(self, engine, blocks, depth: int = 1):
+        self.engine = engine
+        self.blocks = iter(blocks)
+        self.depth = max(0, int(depth))
+
+    def __iter__(self):
+        staged = deque()  # (block, prep), oldest first
+        exhausted = False
+        while True:
+            while not exhausted and len(staged) <= self.depth:
+                blk = next(self.blocks, None)
+                if blk is None:
+                    exhausted = True
+                    break
+                # async H2D starts here, ahead of the caller's dispatch
+                staged.append((blk, self.engine._prepare_block(blk)))
+            if not staged:
+                return
+            yield staged.popleft()

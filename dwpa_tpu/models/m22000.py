@@ -492,6 +492,13 @@ class M22000Engine:
         # Per-stage wall-clock accumulators (SURVEY.md §5.1): host pack +
         # H2D enqueue / device dispatch / sync + decode.  "collect" is
         # where device compute surfaces under the async runtime.
+        # Keys are API (the client's stage log and tests read them).
+        # Since the candidate feed (dwpa_tpu/feed) moved packing onto
+        # producer threads, "prepare" counts only the RESIDUAL on-thread
+        # work — device staging for prepacked blocks, or the full pack
+        # for non-feed callers; producer-side pack time lives in the
+        # feed's ``feed:produce`` spans instead, so the two are never
+        # double-counted.
         self.stage_times = {"prepare": 0.0, "dispatch": 0.0, "collect": 0.0}
         for line in lines:
             try:
@@ -607,6 +614,57 @@ class M22000Engine:
         )
         self.stage_times["prepare"] += time.perf_counter() - t0
         return pws, nvalid, pw_words
+
+    def host_packer(self):
+        """Pure-host packing closure for feed producer threads.
+
+        Captures the batch geometry as plain ints so the closure touches
+        no engine/jax state from the thread (lint rule DW107: producer
+        threads may not touch jax device APIs) — decode, filter and pack
+        only; the consumer thread stages the result via
+        ``_prepare_staged``.  Returns None when the native packer is
+        unavailable (the block then takes the full ``_prepare`` path
+        on-thread, unchanged semantics).
+        """
+        from ..native import pack_candidates_fast
+
+        bs, n = self.batch_size, self.mesh.size
+
+        def pack(words):
+            cap = max(bs, -(-len(words) // n) * n)
+            return pack_candidates_fast(words, MIN_PSK_LEN, MAX_PSK_LEN,
+                                        capacity=cap)
+
+        return pack
+
+    def _prepare_staged(self, packed, lens, nvalid):
+        """Consumer-side residual of ``_prepare`` for a feed-prepacked
+        block: only the device staging (column trim + async H2D) — the
+        packing already happened on a producer thread and is accounted
+        to the feed's ``feed:produce`` spans, so ``stage_times["prepare"]``
+        accumulates just this residual (see the stage_times comment).
+        """
+        from ..parallel import shard_candidates
+
+        t0 = time.perf_counter()
+        if nvalid == 0:
+            return self._padding_prep(t0)
+        target = max(self.batch_size,
+                     -(-nvalid // self.mesh.size) * self.mesh.size)
+        w = _trim_cols(int(lens.max()))
+        pw_words = shard_candidates(
+            self.mesh, np.ascontiguousarray(packed[:target, :w])
+        )
+        self.stage_times["prepare"] += time.perf_counter() - t0
+        return _PackedWords(packed, lens), nvalid, pw_words
+
+    def _prepare_block(self, block):
+        """Prep one feed block (``dwpa_tpu.feed.framing.Block``):
+        staged fast path when the producer prepacked it, full
+        ``_prepare`` otherwise."""
+        if getattr(block, "prep", None) is not None:
+            return self._prepare_staged(*block.prep)
+        return self._prepare(block.words)
 
     def _padding_prep(self, t0):
         """All-padding batch for a shard that contributed no valid words.
@@ -1006,6 +1064,43 @@ class M22000Engine:
                 batch = []
         if batch:
             submit(batch)
+        pipe.drain()
+        return pipe.founds
+
+    def crack_blocks(self, blocks, on_batch=None) -> list:
+        """Crack a framed candidate-block stream (``dwpa_tpu.feed``).
+
+        The feed-era twin of ``crack``: instead of slicing a flat word
+        iterable itself, the engine consumes ``Block``s whose
+        ``(offset, count)`` framing was fixed by the producer — so
+        ``on_batch(consumed, founds)`` reports each block's GLOBAL
+        candidate coverage (count, not local shard rows), which is what
+        the client's resume checkpoint and the multi-host no-rules
+        pass-2 both need (this replaces the ad-hoc global-count closure
+        the client used to wrap around ``crack``).
+
+        Staging is double-buffered (``feed.staging.DeviceStager``): the
+        next block's candidate H2D is enqueued before this block's
+        steps dispatch, and the ``_Pipeline`` trails the hits-gate sync
+        ``PIPELINE_DEPTH`` batches behind — packing (producer threads),
+        upload (stager) and gate latency (pipeline) all hide behind
+        PBKDF2 compute.
+
+        Multi-process contract: identical to ``crack`` — every host
+        must consume the same NUMBER of blocks; the feed's sharded
+        framing guarantees it (an empty local shard arrives as an
+        all-padding block and still dispatches via ``_padding_prep``).
+        """
+        from ..feed.staging import DeviceStager
+
+        pipe = _Pipeline(self, on_batch)
+        for block, prep in DeviceStager(self, blocks):
+            if not self.groups and not pipe.active:
+                break
+            if prep is not None and self.groups:
+                pipe.push(self._dispatch(prep), block.count)
+            else:
+                pipe.skip(block.count)
         pipe.drain()
         return pipe.founds
 

@@ -37,3 +37,10 @@ enable_compilation_cache(
 # against per-batch XLA recompiles.  Imported AFTER the platform setup
 # above — the plugin pulls in jax.
 from dwpa_tpu.analysis.pytest_plugin import recompile_sentinel  # noqa: E402,F401
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP.md); soak tests opt out with
+    # this marker instead of living outside the tree
+    config.addinivalue_line(
+        "markers", "slow: long-running soak tests excluded from tier-1")

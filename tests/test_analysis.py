@@ -443,6 +443,112 @@ def test_dw106_start_stop_pair():
 
 
 # ---------------------------------------------------------------------------
+# DW107: candidate-feed thread discipline
+# ---------------------------------------------------------------------------
+
+
+def test_dw107_blocking_queue_get_in_traced_region():
+    vs = lint("""
+        import jax
+
+        def step(x, in_queue):
+            v = in_queue.get()
+            return x + v
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW107"]
+    assert "blocking" in vs[0].detail and "in_queue" in vs[0].detail
+
+
+def test_dw107_lock_and_event_waits_in_traced_region():
+    vs = lint("""
+        import jax
+
+        def step(x, self):
+            self._lock.acquire()
+            self._done_event.wait()
+            return x
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW107", "DW107"]
+
+
+def test_dw107_nonblocking_gets_and_joins_stay_clean():
+    """dict .get, str .join and os.path.join share method names with
+    the blocking primitives; the receiver heuristic must not flag
+    them — a linter that cries wolf gets baselined into uselessness."""
+    vs = lint("""
+        import os
+        import jax
+
+        def step(x, cfg, parts):
+            k = cfg.get("scale", 1)
+            name = "-".join(["a", "b"])
+            p = os.path.join("a", "b")
+            return x * k
+
+        run = jax.jit(step)
+    """)
+    assert vs == []
+
+
+def test_dw107_blocking_get_outside_trace_is_fine():
+    vs = lint("""
+        def pump(in_queue):
+            return in_queue.get()
+    """)
+    assert vs == []
+
+
+def test_dw107_feed_producer_device_api():
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        class F:
+            def _produce(self):
+                y = jnp.zeros((4,))
+                return jax.device_put(y)
+    """
+    # jnp.zeros is flagged; the allowed H2D staging call is not
+    vs = lint(src, "dwpa_tpu/feed/seeded.py")
+    assert codes(vs) == ["DW107"]
+    assert "producer" in vs[0].detail and "zeros" in vs[0].detail
+    # scoped to dwpa_tpu/feed/: the same source elsewhere is clean
+    assert lint(src, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw107_feed_producer_pure_host_work_clean():
+    vs = lint("""
+        import numpy as np
+
+        class F:
+            def _produce(self):
+                rows = np.zeros((4, 16), np.uint32)
+                self._pack(rows)
+                return rows
+
+            def consume(self):
+                import jax.numpy as jnp
+                return jnp.asarray(self._buf)  # consumer side: allowed
+    """, "dwpa_tpu/feed/seeded.py")
+    assert vs == []
+
+
+def test_dw107_real_feed_tree_is_clean():
+    """The shipped feed subsystem obeys its own discipline."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    for mod in ("__init__", "framing", "pipeline", "staging"):
+        path = os.path.join(root, "dwpa_tpu", "feed", mod + ".py")
+        assert [v for v in lint_file(path, root)
+                if v.code == "DW107"] == [], mod
+
+
+# ---------------------------------------------------------------------------
 # recompilation sentinel
 # ---------------------------------------------------------------------------
 
@@ -700,7 +806,7 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 
 def test_full_tree_violations_all_known_codes():
-    known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106",
+    known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
              "DW201", "DW202", "DW203", "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"

@@ -232,6 +232,18 @@ def test_metrics_after_one_work_unit(server, tmp_path):
     # span durations also land in the registry histogram
     assert reg.value("dwpa_span_seconds", span="work_unit") == 1
 
+    # candidate-feed telemetry (ISSUE-3): both passes consumed from the
+    # feed, so the dwpa_feed_* family is live per pass, block counts are
+    # positive, and the candidate counters cover the unit's stream
+    for feed_name in ("pass1", "pass2"):
+        assert reg.value("dwpa_feed_blocks_total", feed=feed_name) >= 1, \
+            feed_name
+        assert reg.value("dwpa_feed_consumer_starve_seconds",
+                         feed=feed_name) >= 1
+    fed = sum(reg.series("dwpa_feed_candidates_total").values())
+    assert fed >= res.candidates_tried
+    assert reg.value("dwpa_span_seconds", span="feed:produce") >= 2
+
 
 def test_shard_word_blocks_covers_stream_in_lockstep():
     """The no-rules pass-2 slicer (multi-host): per block, the hosts'

@@ -142,5 +142,6 @@ def test_targeted_pass_cracks_isp_default(server, tmp_path):
     res = client.process_work(work)
     assert [f.psk for f in res.founds] == [psk]
     assert res.accepted
-    # per-stage timing surfaced (SURVEY.md §5.1)
-    assert any(m.startswith("stages: pack+h2d=") for m in stages)
+    # per-stage timing surfaced (SURVEY.md §5.1); "stage" = the residual
+    # on-thread staging — packing moved to the feed's producer threads
+    assert any(m.startswith("stages: stage+h2d=") for m in stages)

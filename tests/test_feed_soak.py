@@ -1,0 +1,162 @@
+"""Slow soak: a loopback work unit through the candidate feed with a
+fault-injecting producer.
+
+Tier-1 runs the fast feed units (tests/test_feed.py); this soak —
+``-m slow``, ~30 s — drives the FULL client path (process_work over the
+in-process WSGI server) against a dictionary big enough for many feed
+blocks, kills the producer mid-stream once, and asserts the crash
+contract end to end: the FeedError carries a stream offset, no feed
+threads survive, the resume checkpoint holds a valid block-aligned
+mid-unit offset, and the revived unit fast-forwards from exactly there
+— skipped + retried re-covers the deterministic stream with no gap and
+no double-count — and still cracks the planted PSK.
+"""
+
+import gzip
+import hashlib
+import os
+import threading
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+from dwpa_tpu.feed import FeedError
+from dwpa_tpu.obs import MetricsRegistry
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+from test_client_loopback import LoopbackAPI
+
+pytestmark = pytest.mark.slow
+
+PSK = b"soak-psk-2024"
+ESSID = b"SoakNet"
+BATCH = 64
+WORDS = 4096       # many feed blocks; the PSK sits at the very end
+FAULT_AT = WORDS // 2  # dict-stream index where the producer dies once
+
+
+def _feed_threads():
+    return [t for t in threading.enumerate() if t.name.startswith("dwpa-feed")]
+
+
+class FaultyDictStream:
+    """DictStream twin that raises once, mid-stream — the
+    fault-injecting producer (the feed's producer thread is what
+    executes this iterator)."""
+
+    armed = False
+
+    def __init__(self, source, **kw):
+        from dwpa_tpu.gen import DictStream
+
+        self._real = DictStream(source, **kw)
+
+    def __iter__(self):
+        cls = type(self)
+        for i, w in enumerate(self._real):
+            if cls.armed and i == FAULT_AT:
+                cls.armed = False
+                raise OSError("injected producer fault")
+            yield w
+
+
+@pytest.fixture
+def server(tmp_path):
+    core = ServerCore(Database(":memory:"),
+                      dictdir=str(tmp_path / "dicts"),
+                      capdir=str(tmp_path / "caps"))
+    os.makedirs(core.dictdir, exist_ok=True)
+    words = [b"soakword-%06d" % i for i in range(WORDS - 1)] + [PSK]
+    blob = gzip.compress(b"\n".join(words) + b"\n")
+    with open(os.path.join(core.dictdir, "soak.txt.gz"), "wb") as f:
+        f.write(blob)
+    core.add_hashlines([tfx.make_pmkid_line(PSK, ESSID, seed="soak1")])
+    core.add_dict("dict/soak.txt.gz", "soak.txt.gz",
+                  hashlib.md5(blob).hexdigest(), len(words), rules=None)
+    core.db.x("UPDATE nets SET algo = ''")
+    return core
+
+
+def _release_net(server):
+    server.db.x("UPDATE nets SET n_state = 0, pass = NULL, algo = ''")
+
+
+def _client(server, workdir, **cfg_kw):
+    cfg = ClientConfig(base_url="http://loopback/", workdir=str(workdir),
+                       batch_size=BATCH, dictcount=1, **cfg_kw)
+    api = LoopbackAPI(make_wsgi_app(server))
+    return TpuCrackClient(cfg, api=api, log=lambda *a, **k: None,
+                          registry=MetricsRegistry())
+
+
+def test_soak_fault_mid_stream_then_resume(server, tmp_path, monkeypatch):
+    import dwpa_tpu.client.main as cm
+
+    # -- session A: clean reference run fixes the unit's deterministic
+    # candidate total (pass-1 targeted stream + the dict)
+    clean = _client(server, tmp_path / "work_a")
+    work = clean.api.get_work(1)
+    res_a = clean.process_work(dict(work))
+    assert res_a.accepted and [f.psk for f in res_a.founds] == [PSK]
+    total = res_a.candidates_tried
+    assert total >= WORDS  # pass 1 contributes on top of the dict
+
+    # -- session B: same unit, fault-injecting producer
+    _release_net(server)
+    monkeypatch.setattr(cm, "DictStream", FaultyDictStream)
+    FaultyDictStream.armed = True
+    crashed = _client(server, tmp_path / "work_b")
+    work_b = crashed.api.get_work(1)
+    with pytest.raises(FeedError) as e:
+        crashed.process_work(dict(work_b))
+    assert not FaultyDictStream.armed  # fired exactly once
+    assert isinstance(e.value.__cause__, OSError)
+    # the fault names the failing block's pass-2 stream offset: at or
+    # before the injected word index, at most one block earlier
+    assert FAULT_AT - BATCH <= e.value.offset <= FAULT_AT
+    assert "offset" in str(e.value)
+    # clean teardown: no orphan producer threads survive the crash
+    assert not _feed_threads()
+
+    # the resume checkpoint survived with a mid-unit offset: a true
+    # prefix of the stream, never regressed to zero, never past the
+    # fault (pass-1 candidates precede the dict in the global count)
+    snap = crashed._read_resume()
+    assert snap is not None and snap["hkey"] == work_b["hkey"]
+    done = snap["_progress"]["done"]
+    assert 0 < done < total
+
+    # -- session C: revive from B's workdir; the unit fast-forwards
+    # from the checkpoint and the remainder EXACTLY covers the stream
+    # (deterministic framing: skipped + retried == total, no gap, no
+    # double count)
+    revived = _client(server, tmp_path / "work_b")
+    replay = revived._read_resume()
+    assert replay is not None and replay["_progress"]["done"] == done
+    res_c = revived.process_work(replay)
+    assert res_c.accepted
+    assert [f.psk for f in res_c.founds] == [PSK]
+    assert res_c.candidates_tried == total - done
+    assert revived.registry.value("dwpa_client_resume_skipped_total") == done
+    assert not _feed_threads()
+    assert not os.path.exists(revived.resume_path)
+    row = server.db.q1("SELECT n_state, pass FROM nets")
+    assert row["n_state"] == 1 and row["pass"] == PSK
+    # the pass-2 feed telemetry is live in the client registry
+    assert revived.registry.value("dwpa_feed_blocks_total", feed="pass2") >= 1
+
+
+def test_soak_steady_unit_with_multiworker_feed(server, tmp_path):
+    """No-fault soak at feed_workers=2: a whole unit's dict streams
+    through two producers and the unit completes exactly as with one
+    (the feed's reorder buffer keeps stream order regardless of thread
+    timing)."""
+    client = _client(server, tmp_path / "work2", feed_workers=2,
+                     feed_depth=3)
+    work = client.api.get_work(1)
+    res = client.process_work(work)
+    assert res.accepted
+    assert [f.psk for f in res.founds] == [PSK]
+    assert res.candidates_tried >= WORDS
+    assert not _feed_threads()
