@@ -317,6 +317,82 @@ def bench_feed_overlap(batch: int, batches: int = 8) -> dict:
             "queue_depth": 2, "producers": 1, "recompiles": comp.count}
 
 
+def bench_pmkstore(batch: int, batches: int = 4, overlap: float = 0.875) -> dict:
+    """Persistent PMK store (dwpa_tpu/pmkstore): cold-vs-warm PMK/s on an
+    overlapping dictionary pair.
+
+    The cold pass cracks dictionary A with an empty store — every block
+    is all-miss (plain-path shapes) and its PMKs write back after the
+    device fetch.  The warm pass cracks dictionary B, which shares
+    ``overlap`` of A's words SPREAD UNIFORMLY through the stream (every
+    8th word is fresh at the default 7/8), so every block takes the
+    mixed hit/miss path: PBKDF2 runs only on the compacted miss
+    sub-batch (bucketed to <= 3 static widths — ``recompiles_warm``
+    proves the bound holds) while cached PMKs are gathered in around it.
+    The speedup ceiling is 1/(1-overlap); the measured ratio is how much
+    of the skipped PBKDF2 the store actually returns.  ``hit_ratio``
+    comes from the same isolated registry the store records to, so the
+    headline and the live telemetry cannot disagree.
+    """
+    import tempfile
+
+    from dwpa_tpu.feed import CandidateFeed
+    from dwpa_tpu.obs import MetricsRegistry
+    from dwpa_tpu.pmkstore import PMKStore
+
+    n = batches * batch
+    reg = MetricsRegistry()
+    line = T.make_pmkid_line(b"not-in-either-dict", b"bench-store", seed="pks")
+    # Warm the plain crack-step shapes first (18-char words, like the
+    # dict below) so the COLD pass measures PBKDF2, not XLA compiles;
+    # the store-specific shapes compile inside the warm pass, where the
+    # sentinel counts them.
+    warm_eng = M22000Engine([line], batch_size=batch)
+    warm_eng.crack_batch([b"storewarm-%08d" % i for i in range(batch)])
+
+    def run(words, label):
+        eng = M22000Engine([line], batch_size=batch, pmk_store=store)
+        feed = CandidateFeed(iter(words), batch_size=batch, depth=2,
+                             producers=1, prepack=eng.host_packer(),
+                             registry=MetricsRegistry(), name=label)
+        with TRACER.span(f"bench:{label}") as sp:
+            eng.crack_blocks(feed)
+        feed.close()
+        return sp.seconds
+
+    with tempfile.TemporaryDirectory() as td:
+        store = PMKStore(td, registry=reg)
+        dict_a = [b"storeword-%08d" % i for i in range(n)]
+        period = max(2, round(1 / (1 - overlap)))
+        dict_b = [dict_a[i] if i % period else b"freshword-%08d" % i
+                  for i in range(n)]
+        # One-time mixed-shape warmup at the warm pass's hit ratio: one
+        # block whose hits are seeded host-side (hashlib IS the oracle
+        # PMK) compiles the bucketed miss-PBKDF2 + mix-gather shapes
+        # outside the timed region; the sentinel around it records the
+        # mixed path's bounded compile count (the <= 3 acceptance bound),
+        # and the timed warm pass below must then add ZERO.
+        import hashlib
+
+        mixwarm = [b"mixwarm-%010d" % i for i in range(batch)]
+        seeded = [w for i, w in enumerate(mixwarm) if i % period]
+        store.put(b"bench-store", seeded,
+                  [hashlib.pbkdf2_hmac("sha1", w, b"bench-store", 4096, 32)
+                   for w in seeded])
+        with watch_compiles() as mixed_comp:
+            run(mixwarm, "pmkstore_mixwarm")
+        cold_s = run(dict_a, "pmkstore_cold")
+        with watch_compiles() as comp:
+            warm_s = run(dict_b, "pmkstore_warm")
+        hit_ratio = reg.value("dwpa_pmkstore_hit_ratio") or 0.0
+    return {"label": "pmkstore", "words": n, "batch": batch,
+            "overlap": 1 - 1 / period,
+            "cold_seconds": cold_s, "warm_seconds": warm_s,
+            "cold_pmk_per_s": n / cold_s, "warm_pmk_per_s": n / warm_s,
+            "warm_speedup": cold_s / warm_s, "hit_ratio": hit_ratio,
+            "mixed_compiles": mixed_comp.count, "recompiles_warm": comp.count}
+
+
 def _timed(fn, name: str = "bench:timed") -> float:
     """One rep as a span: the body must sync its own device work (every
     caller passes an engine crack* call, which does)."""
@@ -392,10 +468,16 @@ def bench_unit_overhead(pmkid_small: dict) -> dict:
     t1 = pmkid_small["seconds"]
     w2, t2 = cfg_big["words"], cfg_big["seconds"]
     rate = (w2 - w1) / max(t2 - t1, 1e-9)
-    overhead = max(0.0, t1 - w1 / rate)
+    # The two-point fit can come out negative (timing noise on two
+    # sub-second runs); the clamp keeps the headline sane, but the RAW
+    # value is reported alongside — a run where fixed_overhead_s reads
+    # 0.0 exactly is a clamped fit, not a free engine, and a real
+    # per-unit overhead regression must not hide behind the clamp.
+    raw = t1 - w1 / rate
     return {"label": "unit_overhead", "small_words": w1, "big_words": w2,
             "batch": min(4096, w1),
-            "smallbatch_pmk_per_s": rate, "fixed_overhead_s": overhead}
+            "smallbatch_pmk_per_s": rate, "fixed_overhead_s": max(0.0, raw),
+            "fixed_overhead_raw_s": raw}
 
 
 def _round(cfg: dict) -> dict:
@@ -429,6 +511,7 @@ def main():
     steady = bench_dict_steady(batch)
     feed = bench_host_feed()
     feed_ov = bench_feed_overlap(batch)
+    pmkstore = bench_pmkstore(batch)
     overhead = bench_unit_overhead(pmkid)
 
     value = mask["pmk_per_s"]
@@ -451,6 +534,7 @@ def main():
                     "dict_steady": _round(steady),
                     "host_feed": _round(feed),
                     "feed_overlap": _round(feed_ov),
+                    "pmkstore": _round(pmkstore),
                     "unit_overhead": _round(overhead),
                 },
             }

@@ -50,6 +50,17 @@ Rule codes (stable — referenced by baseline.json and the docs):
   other device call from a thread races the consumer's dispatch order
   (fatal on a multi-process mesh, where enqueue order is a collective
   contract).
+- **DW108 pmkstore-discipline** — the PMK-store contract
+  (``dwpa_tpu/pmkstore``), two shapes: (a) store I/O — a ``lookup``/
+  ``put``/``flush``/``close`` call on a store-named receiver, or an
+  ``mmap`` segment mapping — inside a function under a JAX
+  trace: store reads are host mmap/dict work and a traced region that
+  touches them either fails on a tracer or bakes one lookup's result
+  into the compiled program; (b) a write-back ``<store>.put(...)``
+  outside the consumer thread's allowed set (``pmkstore/`` itself and
+  the engine's post-fetch write-back in ``models/m22000.py``) — a
+  producer-thread or client-side put would race the consumer's append
+  ordering and could serialize a traced region on disk I/O.
 - **DW106 telemetry-discipline** — the obs-layer contract, two shapes:
   (a) a metric/span emission call (``.inc()``/``.dec()``/``.set()``/
   ``.observe()``, excluding jnp's ``x.at[i].set(v)`` functional update)
@@ -84,6 +95,16 @@ SPAN_FILES = ("bench.py", "dwpa_tpu/client/main.py")
 
 #: metric-emission methods DW106 bans inside traced functions
 OBS_EMIT_METHODS = {"inc", "dec", "observe", "set"}
+
+#: PMK-store method calls DW108(a) bans inside traced regions, and the
+#: receiver names that mark the call as store I/O (so ``cfg.lookup``
+#: stays clean while ``pmk_store.lookup`` / ``self._store.put`` flag)
+PMKSTORE_IO_METHODS = {"lookup", "lookup_digests", "put", "flush", "close"}
+_PMKSTORE_RECV = re.compile(r"(?i)(pmk_?store$|^store$|^_store$)")
+#: the consumer-thread write-back set: the only files allowed to call a
+#: store's ``.put`` (DW108(b)) — the store itself and the engine's
+#: post-device-fetch write-back seam
+PMKSTORE_WRITEBACK_FILES = ("dwpa_tpu/pmkstore/", "dwpa_tpu/models/m22000.py")
 
 #: directories whose producer-thread discipline DW107(b) polices
 FEED_DIRS = ("dwpa_tpu/feed",)
@@ -403,6 +424,17 @@ def _check_traced_function(fn, how, static_names, static_nums, path,
                     "either fails on it or bakes a one-time value in "
                     "while serializing the pipeline",
                     _line(src_lines, node)))
+            elif (name == "mmap"
+                    or (name in PMKSTORE_IO_METHODS
+                        and isinstance(node.func, ast.Attribute)
+                        and _PMKSTORE_RECV.search(_recv_name(node.func)))):
+                out.append(Violation(
+                    "DW108", path, node.lineno,
+                    f"pmkstore I/O {name}() inside traced function "
+                    f"({how}) — store reads/writes are host mmap/dict "
+                    "work; a trace either fails on them or bakes one "
+                    "lookup's result into the compiled program",
+                    _line(src_lines, node)))
 
 
 def _is_at_update(f: ast.Attribute) -> bool:
@@ -451,6 +483,29 @@ def _check_feed_producers(tree, path, src_lines, out):
                 "only device_put/shard_candidates (H2D staging) are "
                 "allowed off the consumer thread",
                 _line(src_lines, node)))
+
+
+# ---------------------------------------------------------------------------
+# DW108(b): PMK-store write-back outside the consumer thread's allowed set
+# ---------------------------------------------------------------------------
+
+
+def _check_pmkstore_writeback(tree, path, src_lines, out):
+    """Outside ``PMKSTORE_WRITEBACK_FILES``: any ``<store>.put(...)`` is
+    a write-back from the wrong seam — producer threads and client code
+    must only LOOK UP; appends belong to the engine's consumer-thread
+    post-fetch write-back (or the store's own internals)."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and _call_name(node) == "put"
+                and isinstance(node.func, ast.Attribute)
+                and _PMKSTORE_RECV.search(_recv_name(node.func))):
+            out.append(Violation(
+                "DW108", path, node.lineno,
+                f"pmkstore write-back .put() on "
+                f"'{_recv_name(node.func)}' outside the consumer-thread "
+                f"allowed set ({', '.join(PMKSTORE_WRITEBACK_FILES)}) — "
+                "newly derived PMKs are written back only after the "
+                "engine's device fetch", _line(src_lines, node)))
 
 
 # ---------------------------------------------------------------------------
@@ -739,6 +794,8 @@ def lint_source(src: str, path: str) -> list:
         _check_span_sync(tree, path, src_lines, out)
     if path.startswith(tuple(d + "/" for d in FEED_DIRS)):
         _check_feed_producers(tree, path, src_lines, out)
+    if not path.startswith(PMKSTORE_WRITEBACK_FILES):
+        _check_pmkstore_writeback(tree, path, src_lines, out)
     return out
 
 

@@ -36,6 +36,14 @@ def build_parser():
                    help="candidate-feed producer threads running the host "
                         "stages off the crack loop (0 = inline feed, no "
                         "threads)")
+    p.add_argument("--pmk-cache-dir",
+                   help="persistent PMK store directory: cross-unit "
+                        "PBKDF2->PMK cache with mixed hit/miss crack "
+                        "blocks (README 'PMK store')")
+    p.add_argument("--pmk-cache-max-bytes", type=int,
+                   default=256 * 1024 * 1024,
+                   help="PMK store on-disk cap; oldest segments are "
+                        "evicted beyond it (default 256 MiB)")
     p.add_argument("--multihost", action="store_true",
                    help="join a jax.distributed slice before any engine "
                         "work (TPU pod environment auto-detected); the "
@@ -81,6 +89,8 @@ def main(argv=None):
         rule_workers=args.rule_workers,
         feed_depth=args.feed_depth,
         feed_workers=args.feed_workers,
+        pmk_cache_dir=args.pmk_cache_dir,
+        pmk_cache_max_bytes=args.pmk_cache_max_bytes,
     )
     TpuCrackClient(cfg).run()
 

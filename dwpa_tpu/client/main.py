@@ -152,6 +152,11 @@ class ClientConfig:
                                     # (0 = inline/synchronous feed)
     archive: bool = True            # append-only archive.22000/archive.res
                                     # audit logs (DAW, help_crack.py:453-456)
+    pmk_cache_dir: str = None       # --pmk-cache-dir: persistent cross-unit
+                                    # PBKDF2->PMK cache (dwpa_tpu/pmkstore)
+    pmk_cache_max_bytes: int = 256 * 1024 * 1024
+                                    # --pmk-cache-max-bytes: store size cap
+                                    # (oldest segments evicted beyond it)
 
 
 @dataclass
@@ -222,6 +227,25 @@ class TpuCrackClient:
         from ..utils.compcache import enable_compilation_cache
 
         enable_compilation_cache(os.path.join(config.workdir, "xla_cache"))
+        # Persistent PMK store (optional): repeat (ESSID, word) pairs —
+        # popular ESSIDs across uploads, overlapping dicts, pass-2
+        # replays of pass-1 words — become disk hits instead of PBKDF2.
+        self.pmk_store = None
+        if config.pmk_cache_dir:
+            if jax.process_count() > 1:
+                # The mixed hit/miss dispatch needs every host to agree
+                # on the miss sub-batch width before the shard_map enters
+                # (a collective the producer thread must not run), so the
+                # store stays off on a slice until that exists.
+                self.log("pmk store: disabled on a multi-host slice "
+                         "(miss-width agreement is per-host for now)")
+            else:
+                from ..pmkstore import PMKStore
+
+                self.pmk_store = PMKStore(
+                    config.pmk_cache_dir,
+                    max_bytes=config.pmk_cache_max_bytes,
+                    registry=self.registry)
         self.resume_path = os.path.join(config.workdir, "resume.json")
         self._digest_cache = {}  # (path, size, mtime_ns) -> md5 hex
         self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
@@ -585,9 +609,15 @@ class TpuCrackClient:
             yield from DictStream(self.cfg.additional_dict)
 
     def _record_founds(self, founds: list):
+        # flush + fsync per found: the PSK is (or is about to be)
+        # reported to the server, so a crash between the append and the
+        # page cache reaching disk must not lose the operator's only
+        # local copy of a cracked key.
         with open(self.potfile, "a") as f:
             for fd in founds:
                 f.write(f"{fd.line.raw}:{fd.psk.decode('latin1')}\n")
+                f.flush()
+                os.fsync(f.fileno())
 
     def _archive_work(self, work: dict):
         """Append-only audit logs (DAW fork, help_crack.py:453-456,
@@ -680,7 +710,8 @@ class TpuCrackClient:
             self._archive_work(work)
         prior_cand = list(progress.get("cand", []))
         engine = M22000Engine(
-            work["hashes"], nc=self.cfg.nc, batch_size=self.cfg.batch_size
+            work["hashes"], nc=self.cfg.nc, batch_size=self.cfg.batch_size,
+            pmk_store=self.pmk_store,
         )
         founds = []
         done = skip

@@ -48,7 +48,10 @@ class Block:
     is filled by a prepacking producer (see ``CandidateFeed``):
     ``(rows uint32[cap, 16], lens uint8[nvalid], nvalid)`` — the
     host-packed form ``M22000Engine._prepare_staged`` stages to the
-    device without re-packing.  ``padded`` marks an all-padding block
+    device without re-packing — or a ``pmkstore.stage.MixedPrep`` when
+    the packer is PMK-store-aware (the block pre-split into cache hits
+    and misses, ``M22000Engine._prepare_mixed``).  ``padded`` marks an
+    all-padding block
     (this host's shard of the global block was empty — dispatched
     anyway to keep the slice in lockstep, see ``_padding_prep``).
     """

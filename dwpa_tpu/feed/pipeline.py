@@ -76,8 +76,10 @@ class CandidateFeed:
     framing; the actual count is ``feed.skipped`` and block offsets
     start at ``skip``.  ``nproc``/``pid`` (default: the jax process
     geometry) select sharded framing; ``prepack`` is an optional pure
-    callable ``words -> (rows, lens, nvalid) | None`` (see
-    ``M22000Engine.host_packer``) run on the producer thread.
+    callable ``words -> (rows, lens, nvalid) | MixedPrep | None`` (see
+    ``M22000Engine.host_packer``) run on the producer thread — with a
+    PMK store attached it also performs the per-ESSID cache hit/miss
+    split (``pmkstore.stage.split_block``), still pure host work.
     """
 
     def __init__(self, source, batch_size: int, *, depth: int = 2,

@@ -466,12 +466,18 @@ class M22000Engine:
     """
 
     def __init__(self, lines, nc: int = DEFAULT_NC, batch_size: int = 4096,
-                 verify_with_oracle: bool = True, mesh="auto"):
+                 verify_with_oracle: bool = True, mesh="auto",
+                 pmk_store=None):
         from ..parallel import default_mesh
 
         if mesh == "auto":
             mesh = default_mesh()
         self.mesh = mesh
+        # Optional persistent PBKDF2 cache (dwpa_tpu.pmkstore): the feed
+        # packer splits blocks into cache hits/misses on the producer
+        # threads, the mixed dispatch computes only the misses, and
+        # _collect writes newly derived PMKs back after the device fetch.
+        self.pmk_store = pmk_store
         # Pad batches to a multiple of the mesh size (shard_map needs the
         # candidate axis evenly split).
         n = mesh.size
@@ -625,15 +631,36 @@ class M22000Engine:
         ``_prepare_staged``.  Returns None when the native packer is
         unavailable (the block then takes the full ``_prepare`` path
         on-thread, unchanged semantics).
+
+        With a ``pmk_store`` attached the closure additionally splits the
+        packed block into per-ESSID cache hits and misses
+        (``pmkstore.stage.split_block`` — store lookups are mmap/dict
+        reads, still pure host work) and returns a ``MixedPrep`` the
+        engine's mixed dispatch consumes.  Single-process only: on a
+        multi-host slice the per-host miss counts would pick different
+        static widths and desync the shard_map shapes, so the split
+        would need a width-agreement collective the producer thread must
+        not run — multi-host engines keep the plain path (each host's
+        store still accumulates its own framed slice via write-back).
         """
         from ..native import pack_candidates_fast
 
         bs, n = self.batch_size, self.mesh.size
+        store = self.pmk_store if jax.process_count() == 1 else None
+        essids = list(self._salts) if store is not None else None
 
         def pack(words):
             cap = max(bs, -(-len(words) // n) * n)
-            return pack_candidates_fast(words, MIN_PSK_LEN, MAX_PSK_LEN,
+            fast = pack_candidates_fast(words, MIN_PSK_LEN, MAX_PSK_LEN,
                                         capacity=cap)
+            if fast is None or store is None:
+                return fast
+            packed, lens, nvalid = fast
+            if nvalid == 0:
+                return fast
+            from ..pmkstore.stage import split_block
+
+            return split_block(store, essids, packed, lens, nvalid, bs, n)
 
         return pack
 
@@ -660,11 +687,35 @@ class M22000Engine:
 
     def _prepare_block(self, block):
         """Prep one feed block (``dwpa_tpu.feed.framing.Block``):
-        staged fast path when the producer prepacked it, full
-        ``_prepare`` otherwise."""
-        if getattr(block, "prep", None) is not None:
-            return self._prepare_staged(*block.prep)
-        return self._prepare(block.words)
+        store-split mixed path when the producer looked the block up in
+        the PMK cache, staged fast path when it merely prepacked it,
+        full ``_prepare`` otherwise."""
+        prep = getattr(block, "prep", None)
+        if prep is None:
+            return self._prepare(block.words)
+        from ..pmkstore.stage import MixedPrep
+
+        if isinstance(prep, MixedPrep):
+            return self._prepare_mixed(prep)
+        return self._prepare_staged(*prep)
+
+    def _prepare_mixed(self, mp):
+        """Consumer-side staging of a store-split block: start the async
+        H2D of each group's compacted miss sub-batch (column-trimmed
+        like ``_prepare_staged``); the cached-PMK matrices stay host
+        arrays until dispatch.  Same ``stage_times["prepare"]``
+        accounting as the staged path — the split itself ran on a
+        producer thread and lives in ``feed:produce`` spans."""
+        from ..parallel import shard_candidates
+
+        t0 = time.perf_counter()
+        for ent in mp.entries.values():
+            if ent.nmiss:
+                w = _trim_cols(int(ent.miss_lens.max()))
+                ent.miss_dev = shard_candidates(
+                    self.mesh, np.ascontiguousarray(ent.miss_rows[:, :w]))
+        self.stage_times["prepare"] += time.perf_counter() - t0
+        return _PackedWords(mp.packed, mp.lens), mp.nvalid, mp
 
     def _padding_prep(self, t0):
         """All-padding batch for a shard that contributed no valid words.
@@ -698,12 +749,51 @@ class M22000Engine:
         """
         t0 = time.perf_counter()
         pws, nvalid, pw_words = prep
+        from ..pmkstore.stage import MixedPrep
+
+        if isinstance(pw_words, MixedPrep):
+            return self._dispatch_mixed(pws, nvalid, pw_words, t0)
         outs = []
         for essid in list(self.groups):
             step = self._step_for(essid)
             outs.append((self._full[essid], step(pw_words)))
         self.stage_times["dispatch"] += time.perf_counter() - t0
         return pws, nvalid, outs
+
+    def _dispatch_mixed(self, pws, nvalid, mp, t0):
+        """Mixed hit/miss dispatch (PMK store): per group, PBKDF2 runs
+        only on the compacted miss sub-batch, cached PMKs are gathered
+        around the computed ones into the full ``uint32[8, B]`` matrix
+        (``parallel.step.mix_step``), and the group's verify kernels run
+        unchanged on that matrix — an all-hit block dispatches ZERO
+        PBKDF2 work.  The returned record carries the write-back list
+        (miss PMK device arrays + their words) that ``_collect`` flushes
+        to the store AFTER its device fetch, on the consumer thread
+        (lint rule DW108: write-back never runs in a producer or traced
+        region)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.mesh import DP_AXIS
+        from ..parallel.step import mix_step
+
+        pmk_sharding = getattr(self, "_pmk_sharding", None)
+        if pmk_sharding is None:
+            pmk_sharding = self._pmk_sharding = NamedSharding(
+                self.mesh, PartitionSpec(None, DP_AXIS))
+        outs, writeback = [], []
+        for essid in list(self.groups):
+            step = self._step_for(essid)
+            ent = mp.entries[essid]
+            if ent.nmiss == 0:
+                pmk = jax.device_put(ent.cached, pmk_sharding)
+            else:
+                pmk_miss = step.compute_pmk(ent.miss_dev)
+                writeback.append(
+                    (essid, pmk_miss, ent.miss_words, ent.nmiss))
+                pmk = (pmk_miss if ent.nhit == 0 else
+                       mix_step(self.mesh)(pmk_miss, ent.cached, ent.idx))
+            outs.append((self._full[essid], step.verify(pmk)))
+        self.stage_times["dispatch"] += time.perf_counter() - t0
+        return pws, nvalid, outs, None, writeback
 
     #: Per-host cap on hit columns exchanged in one multi-process batch
     #: (a fixed-size allgather keeps the exchange shape static; real
@@ -925,8 +1015,11 @@ class M22000Engine:
         t0 = time.perf_counter()
         pws, nvalid, outs = dispatched[:3]
         # Rules records carry the dispatch's per-shard width as a 4th
-        # element (see _decode_rules on why it cannot be re-derived).
+        # element (see _decode_rules on why it cannot be re-derived);
+        # mixed-block records carry the PMK-store write-back list as a
+        # 5th (see _dispatch_mixed).
         b_shard = dispatched[3] if len(dispatched) > 3 else None
+        writeback = dispatched[4] if len(dispatched) > 4 else None
         multiproc = jax.process_count() > 1
         founds = []
         live = {id(n.line) for g in self.groups.values() for n in g}
@@ -993,6 +1086,15 @@ class M22000Engine:
             founds += self._decode(group, found, pmk_col, pws, None, live)
         for f in founds:
             self.remove(f)
+        if writeback and self.pmk_store is not None:
+            # PMK-store write-back: the one place newly derived PMKs
+            # leave the device outside a find.  Runs on the consumer
+            # thread after the hits-gate fetch (DW108's allowed seam);
+            # the [8, width] miss matrix is an intentional per-batch
+            # D2H — it is what turns the NEXT unit's repeats into hits.
+            for essid, pmk_dev, miss_words, nmiss in writeback:
+                pmk_host = jax.device_get(pmk_dev)
+                self.pmk_store.put(essid, miss_words, pmk_host[:, :nmiss])
         self.stage_times["collect"] += time.perf_counter() - t0
         return founds
 

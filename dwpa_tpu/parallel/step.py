@@ -210,6 +210,30 @@ def _assemble_step(mesh, struct, v_max, inv):
     return _STEP_CACHE[key]
 
 
+def mix_step(mesh):
+    """jitted ``(pmk_miss[8, Mb], cached[8, B], idx[B]) -> pmk uint32[8, B]``.
+
+    The PMK-store mixed-block assembly: ``pmk_miss`` is the PBKDF2 output
+    of the compacted miss sub-batch, ``cached`` the host-built matrix
+    with cache-hit PMKs at their batch columns, and ``idx`` the gather
+    map over ``concat([pmk_miss, cached], axis=1)`` (misses read their
+    computed slot, hits and padding read ``cached`` at their own column
+    — ``pmkstore.stage.split_block`` builds it).  ``idx`` is data, never
+    a trace constant; one jit object per mesh, so XLA recompiles only
+    per ``(Mb, B)`` shape pair — and the miss widths are bucketed
+    (``pmkstore.stage.miss_widths``, <= 3 values) precisely so that
+    count stays bounded however the hit ratio wanders.
+    """
+    key = (mesh, "mix")
+    if key not in _STEP_CACHE:
+        _STEP_CACHE[key] = jax.jit(
+            lambda pm, cached, idx: jnp.concatenate(
+                [pm, cached], axis=1)[:, idx],
+            out_shardings=NamedSharding(mesh, P(None, DP_AXIS)),
+        )
+    return _STEP_CACHE[key]
+
+
 #: Rules per fused dispatch (build_rules_step).  Fixed so the step's jit
 #: signature is independent of the ruleset size: a 134-line set runs in
 #: ceil(134/8) dispatches, the last padded with noop rules (<= 1 chunk
@@ -370,8 +394,10 @@ def build_crack_step(mesh, nets, salt1, salt2):
     )
     asm = None if trivial else _assemble_step(mesh, tuple(struct), v_max, inv)
 
-    def step(pw_words):
-        pmk = pmk_fn(pw_words, s1, s2)
+    def compute_pmk(pw_words):
+        return pmk_fn(pw_words, s1, s2)
+
+    def verify(pmk):
         hits = None
         fnds = []
         for fn, consts in parts:
@@ -381,4 +407,13 @@ def build_crack_step(mesh, nets, salt1, salt2):
         found = fnds[0] if asm is None else asm(*fnds)
         return hits, found, pmk
 
+    def step(pw_words):
+        return verify(compute_pmk(pw_words))
+
+    # The two halves are the PMK-store seams (M22000Engine._dispatch_mixed):
+    # PBKDF2 over a miss sub-batch of any static width, and verification
+    # of a PMK matrix that arrived by any route (computed, cached via
+    # mix_step, or fully cached) — same jit caches either way.
+    step.compute_pmk = compute_pmk
+    step.verify = verify
     return step

@@ -549,6 +549,99 @@ def test_dw107_real_feed_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# DW108: PMK-store discipline
+# ---------------------------------------------------------------------------
+
+
+def test_dw108_store_lookup_in_traced_region():
+    vs = lint("""
+        import jax
+
+        def step(x, pmk_store):
+            pmks = pmk_store.lookup(b"essid", x)
+            return x
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW108"]
+    assert "host mmap/dict work" in vs[0].detail
+
+
+def test_dw108_mmap_in_traced_region():
+    vs = lint("""
+        import jax
+        import mmap
+
+        def step(x, f):
+            mm = mmap.mmap(f.fileno(), 0)
+            return x
+
+        run = jax.jit(step)
+    """)
+    assert codes(vs) == ["DW108"]
+
+
+def test_dw108_host_side_store_io_clean():
+    """The shipped idiom — producer-thread lookups, consumer-thread
+    write-back — is host code outside any trace and must stay clean."""
+    vs = lint("""
+        def split(pmk_store, essid, words):
+            return pmk_store.lookup(essid, words)
+    """)
+    assert vs == []
+    # dict/config .lookup on a non-store receiver never flags
+    vs = lint("""
+        import jax
+
+        def step(x, table):
+            k = table.lookup
+            return x
+
+        run = jax.jit(step)
+    """)
+    assert vs == []
+
+
+def test_dw108_writeback_outside_consumer_set():
+    """A store .put from a feed producer (or anywhere outside the
+    allowed set) is a write-back from the wrong thread; the engine's
+    post-fetch seam and the store's own internals stay clean."""
+    src = """
+        class F:
+            def _produce(self):
+                self._pmk_store.put(b"e", self.words, self.pmks)
+    """
+    vs = lint(src, "dwpa_tpu/feed/seeded.py")
+    assert codes(vs) == ["DW108"]
+    assert "consumer-thread" in vs[0].detail
+    assert lint(src, "dwpa_tpu/models/m22000.py") == []
+    assert lint(src, "dwpa_tpu/pmkstore/store.py") == []
+
+
+def test_dw108_queue_put_is_not_writeback():
+    """queue.put shares the method name; the receiver heuristic keeps
+    the feed's real queue traffic out of DW108."""
+    vs = lint("""
+        def pump(out_queue, x):
+            out_queue.put(x)
+    """, "dwpa_tpu/feed/seeded.py")
+    assert vs == []
+
+
+def test_dw108_real_pmkstore_tree_is_clean():
+    """The shipped store/stage/engine wiring obeys its own discipline."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    for rel in ("dwpa_tpu/pmkstore/store.py", "dwpa_tpu/pmkstore/stage.py",
+                "dwpa_tpu/pmkstore/__init__.py", "dwpa_tpu/feed/pipeline.py",
+                "dwpa_tpu/client/main.py"):
+        path = os.path.join(root, *rel.split("/"))
+        assert [v for v in lint_file(path, root)
+                if v.code == "DW108"] == [], rel
+
+
+# ---------------------------------------------------------------------------
 # recompilation sentinel
 # ---------------------------------------------------------------------------
 
@@ -807,7 +900,7 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
-             "DW201", "DW202", "DW203", "DW204"}
+             "DW108", "DW201", "DW202", "DW203", "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

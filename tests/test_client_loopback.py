@@ -245,6 +245,66 @@ def test_metrics_after_one_work_unit(server, tmp_path):
     assert reg.value("dwpa_span_seconds", span="feed:produce") >= 2
 
 
+def test_pmkstore_metrics_and_warm_unit(server, tmp_path):
+    """PMK-store loopback contract (the ISSUE-4 acceptance check): with
+    --pmk-cache-dir set, one work unit surfaces the dwpa_pmkstore_*
+    metric set in the registry (and so in the ?metrics scrape rendering),
+    and a REPLAY of the same unit serves its candidates from the cache —
+    hits recorded, the PSK still cracked through cached PMKs."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="pm1")])
+    _add_dict(server, [b"cacheable-%06d" % i for i in range(30)] + [PSK])
+    reg = MetricsRegistry()
+    client = _client(server, tmp_path, registry=reg,
+                     pmk_cache_dir=str(tmp_path / "pmkcache"))
+
+    work = client.api.get_work(client.dictcount)
+    res = client.process_work(dict(work))
+    assert res.accepted and [f.psk for f in res.founds] == [PSK]
+    # cold unit: the dwpa_pmkstore_* family is live — misses counted,
+    # every derived PMK written back, names present in the scrape form
+    assert reg.value("dwpa_pmkstore_misses_total") > 0
+    assert reg.value("dwpa_pmkstore_writes_total") > 0
+    text = reg.render_prometheus()
+    for name in ("dwpa_pmkstore_hits_total", "dwpa_pmkstore_misses_total",
+                 "dwpa_pmkstore_writes_total", "dwpa_pmkstore_bytes",
+                 "dwpa_pmkstore_hit_ratio"):
+        assert name in text, name
+
+    # warm replay of the same unit (server-side state reset): the dict
+    # stream repeats, so pass 2 runs on cache hits
+    server.db.x("UPDATE nets SET n_state = 0, pass = NULL, algo = ''")
+    hits_before = reg.value("dwpa_pmkstore_hits_total") or 0
+    res2 = client.process_work(dict(work))
+    assert res2.accepted and [f.psk for f in res2.founds] == [PSK]
+    assert reg.value("dwpa_pmkstore_hits_total") > hits_before
+    assert 0 < reg.value("dwpa_pmkstore_hit_ratio") <= 1
+
+
+def test_potfile_fsync_per_found(server, tmp_path, monkeypatch):
+    """Potfile appends are flushed AND fsynced per found: a crash right
+    after put_work must not lose the only local copy of a cracked PSK
+    to the page cache."""
+    import dwpa_tpu.client.main as cm
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(cm.os, "fsync",
+                        lambda fd: (synced.append(fd), real_fsync(fd))[1])
+    client = _client(server, tmp_path)
+
+    class _Line:
+        raw = "WPA*01*fsync-test"
+
+    class _Found:
+        line = _Line()
+        psk = b"fsyncpsk1"
+
+    client._record_founds([_Found(), _Found()])
+    assert len(synced) == 2
+    pot = open(client.potfile).read()
+    assert pot.count("fsyncpsk1") == 2
+
+
 def test_shard_word_blocks_covers_stream_in_lockstep():
     """The no-rules pass-2 slicer (multi-host): per block, the hosts'
     shards partition the global stream in order, every host yields the

@@ -1,0 +1,348 @@
+"""dwpa_tpu.pmkstore: the persistent cross-unit PBKDF2 cache.
+
+Three layers under test:
+
+- the STORE (record/frame format, reopen persistence, torn-tail
+  crash-safety via fault injection, segment rotation + eviction under
+  the size cap, hit/miss telemetry);
+- the SPLIT STAGE (bounded static miss widths, per-ESSID hit/miss
+  partitioning, the multi-host framed-slice sharding property);
+- the ENGINE mixed-block path — differential against the pure-Python
+  oracle PMKs (hashlib PBKDF2 is the oracle's kernel) on the same
+  candidate stream: all-hit, all-miss, interleaved and
+  resume-skip-across-cached-blocks, plus the recompile-sentinel proof
+  that the width bucketing keeps XLA compiles bounded.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from dwpa_tpu import testing as synth
+from dwpa_tpu.feed import CandidateFeed
+from dwpa_tpu.feed.framing import frame_blocks
+from dwpa_tpu.models.m22000 import M22000Engine
+from dwpa_tpu.obs import MetricsRegistry
+from dwpa_tpu.pmkstore import (PMKStore, miss_width, miss_widths, split_block,
+                               word_digest)
+
+ESSID = b"StoreNet"
+
+
+def _pmk(word, essid=ESSID):
+    """The oracle's PBKDF2 (oracle/m22000.check_key_m22000 computes PMKs
+    with exactly this hashlib call) — the parity reference."""
+    return hashlib.pbkdf2_hmac("sha1", word, essid, 4096, 32)
+
+
+def _seed(store, words, essid=ESSID):
+    store.put(essid, words, [_pmk(w, essid) for w in words])
+
+
+def _crack(engine, words, registry=None, skip=0, on_batch=None):
+    feed = CandidateFeed(iter(words), batch_size=engine.batch_size,
+                         producers=1, skip=skip,
+                         prepack=engine.host_packer(),
+                         registry=registry or MetricsRegistry())
+    try:
+        return engine.crack_blocks(feed, on_batch=on_batch)
+    finally:
+        feed.close()
+
+
+# ---------------------------------------------------------------------------
+# store: record format, persistence, crash-safety, eviction
+# ---------------------------------------------------------------------------
+
+
+def test_put_lookup_roundtrip(tmp_path):
+    store = PMKStore(str(tmp_path))
+    words = [b"roundtrip-%03d" % i for i in range(10)]
+    _seed(store, words)
+    got = store.lookup(ESSID, words + [b"never-stored"])
+    assert got[:-1] == [_pmk(w) for w in words]
+    assert got[-1] is None
+    # per-ESSID by construction: the same words under another ESSID miss
+    assert store.lookup(b"OtherNet", words) == [None] * len(words)
+
+
+def test_matrix_put_matches_bytes_put(tmp_path):
+    """The engine writes back the device layout (uint32[8, m] columns);
+    it must round-trip identically to explicit 32-byte strings."""
+    store = PMKStore(str(tmp_path))
+    words = [b"matrix-%03d" % i for i in range(5)]
+    cols = np.stack(
+        [np.frombuffer(_pmk(w), dtype=">u4").astype(np.uint32)
+         for w in words], axis=1)
+    store.put(ESSID, words, cols)
+    assert store.lookup(ESSID, words) == [_pmk(w) for w in words]
+
+
+def test_reopen_persists_and_serves_from_mmap(tmp_path):
+    store = PMKStore(str(tmp_path))
+    words = [b"persist-%03d" % i for i in range(32)]
+    _seed(store, words)
+    store.close()
+    back = PMKStore(str(tmp_path))
+    assert back.lookup(ESSID, words) == [_pmk(w) for w in words]
+
+
+def test_torn_tail_skipped_not_fatal(tmp_path):
+    """Fault injection: a segment truncated mid-record (a crash tearing
+    the last appended frame) must open cleanly, skip the torn tail, and
+    keep serving every record of the intact frames."""
+    store = PMKStore(str(tmp_path))
+    first = [b"intact-%03d" % i for i in range(8)]
+    torn = [b"torn-%03d" % i for i in range(8)]
+    _seed(store, first)   # frame 1
+    _seed(store, torn)    # frame 2 — about to be torn
+    store.close()
+    edir = os.path.join(str(tmp_path), ESSID.hex())
+    seg = os.path.join(edir, sorted(os.listdir(edir))[-1])
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(size - 17)  # mid-record: not a frame boundary
+    back = PMKStore(str(tmp_path))
+    assert back.lookup(ESSID, first) == [_pmk(w) for w in first]
+    assert all(p is None for p in back.lookup(ESSID, torn))
+    # and the store still accepts writes after the repair-by-skip
+    _seed(back, torn)
+    assert back.lookup(ESSID, torn) == [_pmk(w) for w in torn]
+
+
+def test_corrupt_frame_crc_skipped(tmp_path):
+    """A flipped byte inside the tail frame (torn write, bit rot) fails
+    the CRC and drops that frame only."""
+    store = PMKStore(str(tmp_path))
+    first = [b"crc-ok-%03d" % i for i in range(4)]
+    bad = [b"crc-bad-%03d" % i for i in range(4)]
+    _seed(store, first)
+    _seed(store, bad)
+    store.close()
+    edir = os.path.join(str(tmp_path), ESSID.hex())
+    seg = os.path.join(edir, sorted(os.listdir(edir))[-1])
+    with open(seg, "r+b") as f:
+        f.seek(os.path.getsize(seg) - 5)
+        f.write(b"\xff")
+    back = PMKStore(str(tmp_path))
+    assert back.lookup(ESSID, first) == [_pmk(w) for w in first]
+    assert all(p is None for p in back.lookup(ESSID, bad))
+
+
+def test_rotation_and_eviction_under_cap(tmp_path):
+    """Segments rotate at segment_bytes and the OLDEST sealed segments
+    are evicted once the total passes max_bytes — the earliest records
+    stop hitting, the newest keep serving, and the bytes gauge tracks."""
+    reg = MetricsRegistry()
+    # tiny geometry: ~25 records per segment, cap at ~4 segments
+    store = PMKStore(str(tmp_path), max_bytes=4096, segment_bytes=1024,
+                     registry=reg)
+    batches = [[b"evict-%02d-%03d" % (b, i) for i in range(16)]
+               for b in range(12)]
+    for batch in batches:
+        _seed(store, batch)
+    assert reg.value("dwpa_pmkstore_evictions_total") > 0
+    assert reg.value("dwpa_pmkstore_bytes") <= 4096 + 1024  # cap + open seg
+    assert all(p is None for p in store.lookup(ESSID, batches[0]))
+    assert store.lookup(ESSID, batches[-1]) == [_pmk(w) for w in batches[-1]]
+    # on-disk state agrees after reopen
+    store.close()
+    back = PMKStore(str(tmp_path), max_bytes=4096, segment_bytes=1024)
+    assert back.lookup(ESSID, batches[-1]) == [_pmk(w) for w in batches[-1]]
+
+
+def test_hit_miss_counters_and_ratio(tmp_path):
+    reg = MetricsRegistry()
+    store = PMKStore(str(tmp_path), registry=reg)
+    words = [b"metric-%03d" % i for i in range(10)]
+    _seed(store, words[:5])
+    store.lookup(ESSID, words)
+    assert reg.value("dwpa_pmkstore_hits_total") == 5
+    assert reg.value("dwpa_pmkstore_misses_total") == 5
+    assert reg.value("dwpa_pmkstore_hit_ratio") == pytest.approx(0.5)
+    assert reg.value("dwpa_pmkstore_writes_total") == 5
+    text = reg.render_prometheus()
+    for name in ("dwpa_pmkstore_hits_total", "dwpa_pmkstore_misses_total",
+                 "dwpa_pmkstore_hit_ratio", "dwpa_pmkstore_bytes"):
+        assert name in text
+
+
+# ---------------------------------------------------------------------------
+# split stage: width buckets + framed-slice sharding
+# ---------------------------------------------------------------------------
+
+
+def test_miss_widths_bounded_and_mesh_aligned():
+    for batch, n in ((2048, 8), (64, 8), (32, 8), (16, 8), (4096, 4)):
+        widths = miss_widths(batch, n)
+        assert 1 <= len(widths) <= 3
+        assert widths[-1] == batch
+        assert all(w % n == 0 and w > 0 for w in widths)
+        # every miss count lands in exactly one static width
+        for m in range(batch + 1):
+            assert miss_width(batch, n, m) in widths
+            assert miss_width(batch, n, m) >= m
+
+
+def test_framed_slices_shard_the_store(tmp_path):
+    """The multi-host property the store leans on: each host's feed
+    framing hands it a disjoint slice of the global stream, so per-host
+    stores (write-back of own-slice PMKs only) shard the cache with no
+    coordination — disjoint contents, union = the whole stream."""
+    words = [b"shard-%04d" % i for i in range(70)]  # ragged tail
+    stores = []
+    for pid in range(2):
+        st = PMKStore(str(tmp_path / f"host{pid}"), pid=pid)
+        for blk in frame_blocks(iter(words), 16, nproc=2, pid=pid):
+            mine = [w for w in blk.words if w != b""]
+            _seed(st, mine)
+        stores.append(st)
+    hit0 = {w for w in words if stores[0].lookup(ESSID, [w])[0] is not None}
+    hit1 = {w for w in words if stores[1].lookup(ESSID, [w])[0] is not None}
+    assert hit0 & hit1 == set()
+    assert hit0 | hit1 == set(words)
+
+
+# ---------------------------------------------------------------------------
+# engine mixed-block parity vs the oracle
+# ---------------------------------------------------------------------------
+
+PSK = b"store-psk-777"
+
+
+def _engine(store, psk=PSK, essid=ESSID, batch=32, seed="pmks-1"):
+    line = synth.make_pmkid_line(psk, essid, seed=seed)
+    return M22000Engine([line], batch_size=batch, pmk_store=store)
+
+
+def test_all_miss_blocks_write_back_oracle_pmks(tmp_path):
+    """Cold store: every block takes the all-miss path (plain shapes),
+    the PSK still cracks, and the write-back leaves oracle-exact PMKs
+    for EVERY candidate of the stream."""
+    store = PMKStore(str(tmp_path))
+    words = [b"coldword-%04d" % i for i in range(63)] + [PSK]
+    founds = _crack(_engine(store), words)
+    assert [f.psk for f in founds] == [PSK]
+    assert store.lookup(ESSID, words) == [_pmk(w) for w in words]
+
+
+def test_all_hit_blocks_use_cached_pmks(tmp_path):
+    """Warm store: with every candidate cached the engine dispatches no
+    PBKDF2 at all — and the find must still come out, through the cached
+    PMK matrix."""
+    store = PMKStore(str(tmp_path))
+    words = [b"warmword-%04d" % i for i in range(63)] + [PSK]
+    _seed(store, words)
+    reg = MetricsRegistry()
+    founds = _crack(_engine(store), words, registry=reg)
+    assert [f.psk for f in founds] == [PSK]
+
+
+def test_all_hit_path_trusts_the_cache(tmp_path):
+    """Negative control proving the cache is actually used: poison the
+    PSK's cached PMK and the device check (which sees only the cached
+    matrix) must NOT report the find a recompute would have."""
+    store = PMKStore(str(tmp_path))
+    words = [b"poison-%04d" % i for i in range(63)] + [PSK]
+    _seed(store, words[:-1])
+    store.put(ESSID, [PSK], [b"\x00" * 32])  # wrong PMK for the PSK
+    founds = _crack(_engine(store), words)
+    assert founds == []
+
+
+def test_interleaved_hit_miss_parity(tmp_path):
+    """Mixed blocks: the planted PSK cracks whether it sits in the hit
+    partition or the miss partition of its block, and the miss PMKs
+    written back match the oracle."""
+    for in_hits in (True, False):
+        store = PMKStore(str(tmp_path / f"hit{in_hits}"))
+        words = [b"mixword-%04d" % i for i in range(63)] + [PSK]
+        seeded = [w for i, w in enumerate(words) if i % 2 == 0 and w != PSK]
+        if in_hits:
+            seeded.append(PSK)
+        _seed(store, seeded)
+        founds = _crack(_engine(store), words)
+        assert [f.psk for f in founds] == [PSK], f"in_hits={in_hits}"
+        assert store.lookup(ESSID, words) == [_pmk(w) for w in words]
+
+
+def test_multi_essid_groups_split_independently(tmp_path):
+    """Two ESSID groups over one stream: one group all-hit, the other
+    all-miss — both nets crack, and each group's write-back lands under
+    its own ESSID."""
+    store = PMKStore(str(tmp_path))
+    e2 = b"OtherStoreNet"
+    psk2 = b"store-psk-888"
+    words = [b"dualword-%04d" % i for i in range(62)] + [PSK, psk2]
+    _seed(store, words)  # ESSID fully cached; e2 fully cold
+    lines = [synth.make_pmkid_line(PSK, ESSID, seed="du1"),
+             synth.make_pmkid_line(psk2, e2, seed="du2")]
+    eng = M22000Engine(lines, batch_size=32, pmk_store=store)
+    founds = _crack(eng, words)
+    assert sorted(f.psk for f in founds) == sorted([PSK, psk2])
+    assert store.lookup(e2, words) == [_pmk(w, e2) for w in words]
+
+
+def test_resume_skip_across_cached_blocks(tmp_path):
+    """A resumed unit fast-forwards the feed PAST cached blocks without
+    disturbing the count contract: consumed sums to exactly the
+    unskipped tail, and a PSK behind a mix of cached/uncached blocks
+    still cracks."""
+    store = PMKStore(str(tmp_path))
+    words = [b"resume-%04d" % i for i in range(127)] + [PSK]
+    _seed(store, words[:64])      # the skipped prefix is (mostly) cached
+    _seed(store, words[96:112:2])  # one later block mixed
+    skip = 48
+    consumed = []
+    founds = _crack(_engine(store), words, skip=skip,
+                    on_batch=lambda c, f: consumed.append(c))
+    assert [f.psk for f in founds] == [PSK]
+    assert sum(consumed) == len(words) - skip
+    # everything the tail touched is cached now, oracle-exact
+    assert store.lookup(ESSID, words[skip:]) == \
+        [_pmk(w) for w in words[skip:]]
+
+
+def test_mixed_widths_recompile_bounded(tmp_path, recompile_sentinel):
+    """The static-width proof: after one warmup per bucket, blocks at
+    ANY hit/miss ratio (all-hit included) reuse compiled programs —
+    zero XLA activity across the sweep."""
+    store = PMKStore(str(tmp_path))
+    batch = 32
+    eng = _engine(store, batch=batch, seed="sentinel")
+    widths = miss_widths(batch, eng.mesh.size)
+    assert len(widths) <= 3
+    n = 0
+
+    def block(nmiss):
+        """One full block with exactly ``nmiss`` uncached words (fixed
+        8-char length so the column-trim width stays constant)."""
+        nonlocal n
+        ws = [b"sw%03d%03d" % (n, i) for i in range(batch)]
+        n += 1
+        _seed(store, ws[nmiss:])
+        return ws
+
+    # warm every static width once (and the all-hit path)
+    for m in list(widths) + [0]:
+        _crack(eng, block(min(m, batch)))
+    with recompile_sentinel(allowed=0, label="mixed width sweep"):
+        for m in (1, 3, 7, 9, 15, 20, 31, 0, batch):
+            _crack(eng, block(min(m, batch)))
+
+
+def test_pmkstore_metrics_through_engine(tmp_path):
+    """The engine wiring records to the store's registry: a cold+warm
+    pair shows misses, then hits, then a live ratio — the
+    dwpa_pmkstore_* family the README documents."""
+    reg = MetricsRegistry()
+    store = PMKStore(str(tmp_path), registry=reg)
+    words = [b"obsword-%04d" % i for i in range(31)] + [PSK]
+    _crack(_engine(store), words, registry=reg)
+    assert reg.value("dwpa_pmkstore_misses_total") >= len(words)
+    assert reg.value("dwpa_pmkstore_writes_total") == len(words)
+    _crack(_engine(store, seed="pmks-2"), words, registry=reg)
+    assert reg.value("dwpa_pmkstore_hits_total") >= len(words)
+    assert 0 < reg.value("dwpa_pmkstore_hit_ratio") < 1
