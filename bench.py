@@ -393,6 +393,86 @@ def bench_pmkstore(batch: int, batches: int = 4, overlap: float = 0.875) -> dict
             "mixed_compiles": mixed_comp.count, "recompiles_warm": comp.count}
 
 
+def bench_small_units(nunits: int = 8, words_per_unit: int = 1000,
+                      batch: int = None) -> dict:
+    """bench:small_units — the unit-fusion acceptance measurement.
+
+    The structural gap this quantifies (see unit_overhead and the
+    dict_steady-vs-pmkid_dict ratio): a stream of SMALL ESSID-group x
+    dict work units runs each unit alone, padding its ~1k candidates to
+    the full compiled batch width — per-unit fixed costs plus dead
+    padding lanes, not the PBKDF2 kernel, bound aggregate PMK/s.
+
+    Serial leg: one engine per unit (the client's per-unit loop), each
+    cracking its own 1k-word dict at the configured batch.  Fused leg:
+    ONE engine over all the units' lines, ``crack_fused`` packing the
+    same candidates into one mixed-ESSID batch with per-lane salt
+    gather (dwpa_tpu/sched).  Same candidates, same founds — the
+    speedup is pure fill.  The compile sentinel around the fused leg
+    must read 0: both legs run after same-shaped warmups, so the
+    headline ratio is steady-state, not compile noise.
+    """
+    from dwpa_tpu.sched import fused_width
+
+    batch = batch or (131072 if ON_TPU else 8192)
+    nmesh = len(jax.devices())
+
+    def make_units(tag):
+        units = []
+        for i in range(nunits):
+            psk = ("fusedpass-%s-%03d" % (tag, i)).encode()
+            essid = ("bench-small-%s-%d" % (tag, i)).encode()
+            line = T.make_pmkid_line(psk, essid, seed=f"su-{tag}-{i}")
+            words = [("su%s%d-%07d" % (tag, i, j)).encode()
+                     for j in range(words_per_unit - 1)] + [psk]
+            units.append((line, essid, words, psk))
+        return units
+
+    # Warm both legs' shapes outside the timed regions: the serial crack
+    # step at the full batch, and the fused per-lane step + verify at
+    # the width the timed unit mix lands on.
+    for line, _, words, _ in make_units("warm-serial")[:1]:
+        M22000Engine([line], batch_size=batch).crack(words)
+    warm = make_units("warm-fused")
+    M22000Engine([u[0] for u in warm], batch_size=batch).crack_fused(
+        [(u[1], u[2]) for u in warm], max_units=nunits)
+
+    units = make_units("run")
+    n = nunits * words_per_unit
+    expected = sorted((e, p) for _, e, _, p in units)
+
+    serial_found = []
+    with TRACER.span("bench:small_units_serial") as sp:
+        for line, _, words, _ in units:
+            for f in M22000Engine([line], batch_size=batch).crack(words):
+                serial_found.append((f.line.essid, f.psk))
+    serial_s = sp.seconds
+
+    fused_eng = M22000Engine([u[0] for u in units], batch_size=batch)
+    fb_stats = []
+    with watch_compiles() as comp:
+        with TRACER.span("bench:small_units_fused") as sp:
+            fused = fused_eng.crack_fused(
+                [(u[1], u[2]) for u in units], max_units=nunits,
+                on_fused=lambda fb: fb_stats.append((len(fb.units), fb.fill)))
+        fused_s = sp.seconds
+    fused_found = [(f.line.essid, f.psk) for f in fused]
+    assert sorted(serial_found) == expected, "serial leg missed a planted PSK"
+    founds_identical = sorted(fused_found) == sorted(serial_found)
+    assert founds_identical, "fused leg's founds differ from the serial leg"
+
+    return {"label": "small_units", "units": nunits,
+            "words_per_unit": words_per_unit, "batch": batch,
+            "fused_width": fused_width(batch, nmesh, n),
+            "serial_seconds": serial_s, "fused_seconds": fused_s,
+            "serial_pmk_per_s": n / serial_s, "fused_pmk_per_s": n / fused_s,
+            "aggregate_speedup": serial_s / fused_s,
+            "units_per_batch": max(u for u, _ in fb_stats),
+            "fill_fraction": max(f for _, f in fb_stats),
+            "founds_identical": founds_identical,
+            "recompiles": comp.count}
+
+
 def _timed(fn, name: str = "bench:timed") -> float:
     """One rep as a span: the body must sync its own device work (every
     caller passes an engine crack* call, which does)."""
@@ -512,6 +592,7 @@ def main():
     feed = bench_host_feed()
     feed_ov = bench_feed_overlap(batch)
     pmkstore = bench_pmkstore(batch)
+    small_units = bench_small_units()
     overhead = bench_unit_overhead(pmkid)
 
     value = mask["pmk_per_s"]
@@ -535,6 +616,7 @@ def main():
                     "host_feed": _round(feed),
                     "feed_overlap": _round(feed_ov),
                     "pmkstore": _round(pmkstore),
+                    "small_units": _round(small_units),
                     "unit_overhead": _round(overhead),
                 },
             }

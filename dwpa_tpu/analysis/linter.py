@@ -61,6 +61,14 @@ Rule codes (stable — referenced by baseline.json and the docs):
   the engine's post-fetch write-back in ``models/m22000.py``) — a
   producer-thread or client-side put would race the consumer's append
   ordering and could serialize a traced region on disk I/O.
+- **DW109 fused-pad-width** — a ``np.zeros``/``np.empty`` ``[W, 16]``
+  row-buffer allocation in the fused-batch packers (``sched/fuse.py``,
+  ``pmkstore/stage.py``) whose width does not come from the static
+  fused-width pad table (``fused_width``/``miss_width`` or a value
+  derived from them).  Per-lane salt/candidate rows entering
+  ``pmk_kernel`` at a data-dependent width would retrace the PBKDF2
+  step per unit combination — the compile-per-work-unit failure the
+  width tables exist to prevent (recompile-sentinel proof in tests).
 - **DW106 telemetry-discipline** — the obs-layer contract, two shapes:
   (a) a metric/span emission call (``.inc()``/``.dec()``/``.set()``/
   ``.observe()``, excluding jnp's ``x.at[i].set(v)`` functional update)
@@ -140,7 +148,16 @@ _BAD_DTYPES = {
 SYNC_MARKERS = {
     "block_until_ready", "asarray", "item", "array",
     "crack", "crack_batch", "crack_rules", "crack_mask", "crack_blocks",
+    "crack_fused",
 }
+
+#: files whose [W, 16] row-buffer allocations DW109 polices — the
+#: fused/mixed batch packers that feed per-lane rows to pmk_kernel
+FUSED_PAD_FILES = ("dwpa_tpu/sched/fuse.py", "dwpa_tpu/pmkstore/stage.py")
+#: width-producing calls DW109 accepts (the static pad tables)
+FUSED_WIDTH_SOURCES = {"fused_width", "miss_width"}
+#: table-returning calls whose subscript DW109 also accepts
+FUSED_WIDTH_TABLES = {"fused_widths", "miss_widths"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -766,6 +783,76 @@ def _check_span_sync(tree, path, src_lines, out):
                 path, src_lines, out)
 
 
+def _check_fused_pad_widths(tree, path, src_lines, out):
+    """DW109: ``[W, 16]`` row buffers in the fused-batch packers must
+    take ``W`` from the static fused-width pad table.
+
+    A width expression is accepted when it provably resolves to the
+    tables: a constant, a ``fused_width``/``miss_width`` call, a
+    subscript of ``fused_widths``/``miss_widths``, a ``max``/``min``
+    over accepted values, a conditional whose branches are accepted, or
+    a local name every assignment of which is accepted.  Anything else
+    (a parameter, ``len(...)``, arithmetic on a count) is a
+    data-dependent pad width — each distinct value retraces the fused
+    PBKDF2 step, the compile-per-unit-combination failure the tables
+    exist to prevent."""
+    seen = set()  # nested defs are walked by their enclosing def too
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        assigns = {}
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                assigns.setdefault(node.targets[0].id, []).append(node.value)
+
+        def accepted(expr, trail=()):
+            if isinstance(expr, ast.Constant):
+                return True
+            if isinstance(expr, ast.Call):
+                name = _call_name(expr)
+                if name in FUSED_WIDTH_SOURCES:
+                    return True
+                if name in ("max", "min"):
+                    return all(accepted(a, trail) for a in expr.args)
+                return False
+            if isinstance(expr, ast.Subscript):
+                return (isinstance(expr.value, ast.Call)
+                        and _call_name(expr.value) in FUSED_WIDTH_TABLES)
+            if isinstance(expr, ast.IfExp):
+                return (accepted(expr.body, trail)
+                        and accepted(expr.orelse, trail))
+            if isinstance(expr, ast.Name):
+                if expr.id in trail:  # assignment cycle: refuse
+                    return False
+                vals = assigns.get(expr.id)
+                return bool(vals) and all(
+                    accepted(v, trail + (expr.id,)) for v in vals)
+            return False
+
+        for node in ast.walk(fn):
+            if (id(node) in seen
+                    or not isinstance(node, ast.Call)
+                    or not _is_np_attr(node.func, "zeros")
+                    and not _is_np_attr(node.func, "empty")):
+                continue
+            seen.add(id(node))
+            if not (node.args and isinstance(node.args[0], ast.Tuple)
+                    and len(node.args[0].elts) == 2):
+                continue
+            w, cols = node.args[0].elts
+            if not (isinstance(cols, ast.Constant) and cols.value == 16):
+                continue
+            if not accepted(w):
+                out.append(Violation(
+                    "DW109", path, node.lineno,
+                    f"[W, 16] row buffer in {fn.name}() has a "
+                    "data-dependent width — per-lane rows entering "
+                    "pmk_kernel must be padded to the static fused-width "
+                    "pad table (fused_width/miss_width)",
+                    _line(src_lines, node)))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -796,6 +883,8 @@ def lint_source(src: str, path: str) -> list:
         _check_feed_producers(tree, path, src_lines, out)
     if not path.startswith(PMKSTORE_WRITEBACK_FILES):
         _check_pmkstore_writeback(tree, path, src_lines, out)
+    if path in FUSED_PAD_FILES:
+        _check_fused_pad_widths(tree, path, src_lines, out)
     return out
 
 

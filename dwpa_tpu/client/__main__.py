@@ -44,6 +44,13 @@ def build_parser():
                    default=256 * 1024 * 1024,
                    help="PMK store on-disk cap; oldest segments are "
                         "evicted beyond it (default 256 MiB)")
+    p.add_argument("--unit-queue", type=int, default=4,
+                   help="work units prefetched ahead of the device by "
+                        "the fused multi-unit executor (README 'Unit "
+                        "fusion'; single-host only)")
+    p.add_argument("--fuse-max-units", type=int, default=8,
+                   help="max work units packed into one fused device "
+                        "batch (one salt-table row per ESSID)")
     p.add_argument("--multihost", action="store_true",
                    help="join a jax.distributed slice before any engine "
                         "work (TPU pod environment auto-detected); the "
@@ -91,6 +98,8 @@ def main(argv=None):
         feed_workers=args.feed_workers,
         pmk_cache_dir=args.pmk_cache_dir,
         pmk_cache_max_bytes=args.pmk_cache_max_bytes,
+        unit_queue=args.unit_queue,
+        fuse_max_units=args.fuse_max_units,
     )
     TpuCrackClient(cfg).run()
 

@@ -157,6 +157,12 @@ class ClientConfig:
     pmk_cache_max_bytes: int = 256 * 1024 * 1024
                                     # --pmk-cache-max-bytes: store size cap
                                     # (oldest segments evicted beyond it)
+    unit_queue: int = 4             # --unit-queue: work units prefetched
+                                    # ahead of the device by the fused
+                                    # executor (dwpa_tpu/sched)
+    fuse_max_units: int = 8         # --fuse-max-units: max work units
+                                    # packed into one fused device batch
+                                    # (one salt-table row per ESSID)
 
 
 @dataclass
@@ -210,6 +216,22 @@ class TpuCrackClient:
             "work units completed, by server verdict")
         self._m_founds = reg.counter(
             "dwpa_client_founds_total", "cracked PSKs recovered")
+        self._m_engine_retries = reg.counter(
+            "dwpa_client_engine_retries_total",
+            "work units retried in-process after an engine error")
+        # Fused-executor families are registered up front (idempotent by
+        # name — fused_executor() binds the same series) so a metrics
+        # scrape shows them at zero before the first fused wave runs.
+        from ..sched.executor import UNITS_PER_BATCH_BUCKETS
+
+        reg.histogram(
+            "dwpa_fused_units_per_batch",
+            "Work units packed into each fused device batch",
+            buckets=UNITS_PER_BATCH_BUCKETS)
+        reg.gauge("dwpa_fused_fill_fraction",
+                  "Real-candidate fraction of the last fused batch")
+        reg.gauge("dwpa_unit_queue_depth",
+                  "Prefetched work units waiting in the executor queue")
         if config.additional_dict and jax.process_count() > 1:
             # A per-host local file cannot feed a multi-host slice: the
             # pass-1 streams must be byte-identical on every host or the
@@ -889,6 +911,80 @@ class TpuCrackClient:
             self._m_autotune.labels(direction="down").inc()
         self._m_dictcount.set(self.dictcount)
 
+    def fused_executor(self, units):
+        """A ``sched.MultiUnitExecutor`` bound to this client's config,
+        telemetry and PMK store — the multi-unit fused crack path
+        (``--unit-queue`` / ``--fuse-max-units``).
+
+        Single-host only, for the same reason as the PMK store above:
+        fused waves are assembled from whatever units the queue holds,
+        so different hosts would enter the shard_map collectives with
+        different batch shapes.  A multi-host slice doesn't need fusion
+        anyway — it exists to fill one SMALL slice from a thin stream
+        of small units.
+        """
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "unit fusion is single-host only (a multi-host slice "
+                "takes the serial per-unit path; see fused_executor)")
+        from ..sched import MultiUnitExecutor
+
+        return MultiUnitExecutor(
+            units, batch_size=self.cfg.batch_size,
+            unit_queue=self.cfg.unit_queue,
+            fuse_max_units=self.cfg.fuse_max_units,
+            nc=self.cfg.nc, pmk_store=self.pmk_store,
+            registry=self.registry, tracer=self.tracer)
+
+    #: In-process crack attempts per work unit before the unit is
+    #: abandoned (attempt 1 at the configured batch, each retry attempt
+    #: at half — see _process_with_recovery).
+    ENGINE_RETRY_LIMIT = 3
+
+    def _process_with_recovery(self, work: dict):
+        """One work unit with in-process engine recovery (single-host).
+
+        A crack dispatch that raises — a device falling off the bus, an
+        XLA OOM at the configured batch — used to kill the whole client
+        and lose the unit.  Instead: retry ONCE at half the batch size
+        (an OOM at B usually fits at B/2; a transient device error just
+        needs the re-dispatch), dropping the ``_progress`` checkpoint
+        first because skip-by-count is only sound against the stream
+        order of the batch size that wrote it (see _write_resume).  A
+        second failure requeues the unit with backoff via the resume
+        file; ``ENGINE_RETRY_LIMIT`` total attempts abandon it rather
+        than wedge the loop.  Returns None when no result was produced.
+        """
+        try:
+            return self.process_work(work)
+        except (NoNets, SystemExit, KeyboardInterrupt):
+            raise
+        except RuntimeError as e:
+            self._m_engine_retries.inc()
+            full = self.cfg.batch_size
+            self.log(f"engine error: {e}; retrying unit at batch {full // 2}")
+            work.pop("_progress", None)  # unsound across a batch change
+            try:
+                self.cfg.batch_size = max(1, full // 2)
+                return self.process_work(work)
+            except RuntimeError as e2:
+                work.pop("_progress", None)
+                attempts = int(work.get("_attempts", 0)) + 1
+                work["_attempts"] = attempts
+                self.cfg.batch_size = full  # restore BEFORE stamping resume
+                if attempts >= self.ENGINE_RETRY_LIMIT:
+                    self._clear_resume()
+                    self.log(f"engine error persisted after {attempts} "
+                             f"attempts; abandoning unit: {e2}")
+                else:
+                    self._write_resume(work)
+                    self.log(f"engine error persisted: {e2}; unit requeued "
+                             f"with backoff (attempt {attempts})")
+                    self.api.sleep(self.api.backoff)
+                return None
+            finally:
+                self.cfg.batch_size = full
+
     def run(self) -> int:
         """Update-check + challenge-gate, then loop work units.
 
@@ -964,7 +1060,12 @@ class TpuCrackClient:
                     self.log("no nets available; sleeping")
                     self.api.sleep(self.api.backoff)
                     continue
-            res = self.process_work(work)
+            if multiproc:
+                res = self.process_work(work)
+            else:
+                res = self._process_with_recovery(work)
+                if res is None:
+                    continue  # unit requeued (resume file) or abandoned
             done += 1
             self.log(
                 f"work {res.hkey[:8]}: {len(res.founds)} founds / "

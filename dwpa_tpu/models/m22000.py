@@ -209,6 +209,14 @@ def _pmk_impl(pw_words, salt1, salt2, use_pallas=None):
     axon tunnel costs ~0.24 s/MB, so a 12-byte dict word must not pay
     for a 64-byte row) and the zero tail of the HMAC key block is
     reconstituted here, on device, where padding is a free fusion.
+
+    ``salt1``/``salt2`` are either uint32[16] (one ESSID for the whole
+    batch — the scalar-salt fast path every mask/steady dispatch keeps)
+    or uint32[B, 16] (PER-LANE salts: lane b hashes its own ESSID — the
+    mixed-ESSID fused batch path, ``parallel.step.fused_pmk_step``).
+    jit keys on the salt rank, so the two modes never share or thrash a
+    cache entry; per-lane widths must come from the static fused-width
+    pad table (lint rule DW109) so the 2-D entries stay bounded too.
     """
     if use_pallas is None:
         use_pallas = _use_pallas()
@@ -217,12 +225,16 @@ def _pmk_impl(pw_words, salt1, salt2, use_pallas=None):
     if use_pallas:
         return pbkdf2_sha1_pmk_pallas(pw_words, salt1, salt2)
     pw = [pw_words[:, i] for i in range(16)]
-    s1 = [salt1[i] for i in range(16)]
-    s2 = [salt2[i] for i in range(16)]
+    if salt1.ndim == 2:
+        s1 = [salt1[:, i] for i in range(16)]
+        s2 = [salt2[:, i] for i in range(16)]
+    else:
+        s1 = [salt1[i] for i in range(16)]
+        s2 = [salt2[i] for i in range(16)]
     return jnp.stack(pbkdf2_sha1_pmk(pw, s1, s2))
 
 
-#: pmk_kernel(pw_words[B,16], salt1[16], salt2[16]) -> uint32[8, B]
+#: pmk_kernel(pw_words[B,16], salt1[16]|[B,16], salt2 likewise) -> uint32[8, B]
 pmk_kernel = jax.jit(_pmk_impl, static_argnames=("use_pallas",))
 
 
@@ -401,6 +413,23 @@ class _RuleWords:
         if out is None or not MIN_PSK_LEN <= len(out) <= MAX_PSK_LEN:
             return None  # rejected/out-of-range: column was zeroed on device
         return out
+
+
+class _ShiftedWords:
+    """pws view for one unit's lane window inside a fused batch: batch
+    column ``b`` maps to the unit's own candidate list at ``b - lo``;
+    columns outside the window (other units' lanes, padding) decode to
+    None so ``_decode`` skips them even if a demux mask ever slipped."""
+
+    __slots__ = ("words", "lo")
+
+    def __init__(self, words, lo):
+        self.words = words
+        self.lo = lo
+
+    def __getitem__(self, b):
+        i = b - self.lo
+        return self.words[i] if 0 <= i < len(self.words) else None
 
 
 class _Pipeline:
@@ -1205,6 +1234,215 @@ class M22000Engine:
                 pipe.skip(block.count)
         pipe.drain()
         return pipe.founds
+
+    def crack_fused(self, parts, on_batch=None, max_units=8, tracer=None,
+                    on_fused=None) -> list:
+        """Crack several small work units as fused mixed-ESSID batches.
+
+        ``parts``: iterable of ``(essid, words[, count])`` — one entry
+        per (work unit, ESSID) pair, where ``words`` is the unit's raw
+        candidate list for that ESSID and ``count`` its global coverage
+        (defaults to ``len(words)``; the resume-framing analog of
+        ``feed.framing.Block.count``).  Units are buffered and packed
+        into full device batches (``sched.fuse.fuse_units``): up to
+        ``max_units`` units per batch, flushed early when the next part
+        would overflow ``batch_size`` or reuse a pending ESSID (one
+        salt-table row per ESSID per batch).  Oversize parts split into
+        engine-sized chunks and ride the same machinery.
+
+        This is the small-unit throughput fix (BENCH unit_overhead):
+        serially, every ~1k-word unit pads to the compiled batch width
+        and pays the per-dispatch fixed costs alone; fused, eight such
+        units share one batch and one set of round trips.
+
+        ``on_batch(essid, consumed, founds)`` fires per PART in stream
+        order — same at-least-once checkpoint seam as ``crack_blocks``,
+        keyed by ESSID so a multi-unit caller can demux.  ``on_fused``
+        (optional) receives each ``FusedBatch`` before dispatch — the
+        executor's fill/units-per-batch metrics hook.  ``tracer``
+        (optional ``obs.trace.SpanTracer``) wraps packing in
+        ``sched:fuse`` and sync/demux in ``sched:demux`` spans.
+
+        Single-process only: fusion exists to fill ONE small slice from
+        a thin work-unit stream; a multi-host slice implies work units
+        big enough to saturate it, and the lockstep block contract
+        (every host, same batch count) would make partial waves hang.
+        """
+        import collections
+        from contextlib import nullcontext
+        from ..sched.fuse import fuse_units
+
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "crack_fused is single-process only (multi-host slices "
+                "take the crack_blocks path; see the method docstring)")
+
+        pipe_founds = []
+        inflight = collections.deque()  # (fb, outs, wb), oldest first
+        pending = []                    # buffered (essid, words, count)
+        raw = 0                         # candidate estimate of pending
+
+        def finish_one():
+            fb, outs, wb = inflight.popleft()
+            pipe_founds.extend(
+                self._collect_fused(fb, outs, wb, on_batch, tracer))
+
+        def flush():
+            nonlocal pending, raw
+            if not pending:
+                return
+            parts_now, pending, raw = pending, [], 0
+            with (tracer.span("sched:fuse") if tracer else nullcontext()):
+                fb = fuse_units(parts_now, self.batch_size, self.mesh.size,
+                                max_units, store=self.pmk_store,
+                                salts=self._salts)
+            if on_fused is not None:
+                on_fused(fb)
+            if fb.total == 0:
+                # Every candidate was invalid: nothing to dispatch, but
+                # the units' coverage must still reach the checkpoint.
+                if on_batch is not None:
+                    for u in fb.units:
+                        on_batch(u.key, u.count, [])
+                return
+            inflight.append(self._dispatch_fused(fb))
+            if len(inflight) > self.PIPELINE_DEPTH:
+                finish_one()
+
+        for part in parts:
+            key, words = part[0], list(part[1])
+            count = part[2] if len(part) > 2 else len(words)
+            if not self.groups and not inflight:
+                break  # everything cracked; stop consuming the stream
+            if key not in self.groups:
+                # Unit for an already-cracked (or unknown) ESSID: consume
+                # it so the caller's checkpoint advances past it.
+                if on_batch is not None:
+                    on_batch(key, count, [])
+                continue
+            # Oversize unit: split into engine-sized chunks; each chunk
+            # fuses (alone — a full chunk flushes whatever is pending).
+            while len(words) > self.batch_size:
+                chunk, words = words[:self.batch_size], words[self.batch_size:]
+                count -= len(chunk)
+                flush()
+                pending, raw = [(key, chunk, len(chunk))], len(chunk)
+                flush()
+            if (raw + len(words) > self.batch_size
+                    or any(k == key for k, _, _ in pending)
+                    or len(pending) >= max_units):
+                flush()
+            pending.append((key, words, count))
+            raw += len(words)
+        flush()
+        while inflight:
+            finish_one()
+        return pipe_founds
+
+    def _dispatch_fused(self, fb):
+        """Launch one fused batch (no host sync): ONE per-lane-salt
+        PBKDF2 over the compacted miss lanes (``fused_pmk_step`` — the
+        unit_id gather resolves each lane's salt on device), the mixed
+        ``mix_step`` gather when the PMK store contributed hits, then
+        every live unit's verify kernels over the SAME [8, W] PMK
+        matrix.  A unit's verify sees other units' lanes too — their
+        PMKs were derived under a different ESSID, so they cannot match
+        (and ``_collect_fused`` masks the columns anyway)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel import shard_candidates
+        from ..parallel.mesh import DP_AXIS, shard_vector
+        from ..parallel.step import fused_pmk_step, mix_step
+
+        t0 = time.perf_counter()
+        pmk_sharding = getattr(self, "_pmk_sharding", None)
+        if pmk_sharding is None:
+            pmk_sharding = self._pmk_sharding = NamedSharding(
+                self.mesh, PartitionSpec(None, DP_AXIS))
+        wb = None
+        if fb.nmiss == 0 and fb.cached is not None:
+            # Every lane was a store hit: zero PBKDF2 dispatched.
+            pmk = jax.device_put(fb.cached, pmk_sharding)
+        else:
+            w = _trim_cols(int(fb.miss_lens.max()) if fb.nmiss
+                           else MIN_PSK_LEN)
+            rows_dev = shard_candidates(
+                self.mesh, np.ascontiguousarray(fb.miss_rows[:, :w]))
+            uid_dev = shard_vector(self.mesh, fb.unit_id)
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            t1 = jax.device_put(fb.table1, repl)
+            t2 = jax.device_put(fb.table2, repl)
+            pmk_miss = fused_pmk_step(self.mesh)(rows_dev, uid_dev, t1, t2)
+            entries = [(u.key, u.mlo, u.nmiss, u.miss_words)
+                       for u in fb.units if u.nmiss]
+            wb = (pmk_miss, entries)
+            pmk = (pmk_miss if fb.idx is None else
+                   mix_step(self.mesh)(pmk_miss, fb.cached, fb.idx))
+        outs = []
+        for u in fb.units:
+            group = self._full.get(u.key)
+            if group is None:  # group cracked out from under the stream
+                outs.append((u, None, None))
+                continue
+            outs.append((u, group, self._step_for(u.key).verify(pmk)))
+        self.stage_times["dispatch"] += time.perf_counter() - t0
+        return fb, outs, wb
+
+    def _collect_fused(self, fb, outs, wb, on_batch, tracer) -> list:
+        """Sync + demux one fused batch: gate each unit's verify on its
+        hit scalar, mask the found matrix down to the unit's OWN lane
+        window ``[lo, lo + nvalid)`` before decode (a hit in unit A must
+        never surface as unit B's find — the columns outside the window
+        belong to other units), prune cracked nets, write new PMKs back
+        to the store, and fire ``on_batch`` per unit in layout order."""
+        from contextlib import nullcontext
+
+        t0 = time.perf_counter()
+        founds = []
+        by_unit = {id(u): [] for u, _, _ in outs}
+        live = {id(n.line) for g in self.groups.values() for n in g}
+        with (tracer.span("sched:demux") if tracer else nullcontext()):
+            real = [(u, g, out) for u, g, out in outs if out is not None]
+            fetched = None
+            payload = sum(int(a.nbytes) for _, _, out in real
+                          for a in out[1:])
+            if real and payload <= self.SMALL_FETCH_BYTES:
+                # One merged round trip for every unit's (hits, find
+                # data) — fused batches exist to amortize exactly this
+                # fixed cost (see SMALL_FETCH_BYTES).
+                fetched = jax.device_get([out for _, _, out in real])
+            for i, (u, group, out) in enumerate(real):
+                if fetched is not None:
+                    out = fetched[i]
+                if int(np.asarray(out[0])) == 0:
+                    continue
+                hits, found_dev, pmk_dev = out
+                found, pmk_host = jax.device_get((found_dev, pmk_dev))
+                found = np.array(found)
+                # Demux mask: zero every column outside this unit's lane
+                # window (other units' candidates + padding).
+                found[:, :, :u.lo] = False
+                found[:, :, u.lo + u.nvalid:] = False
+                new = self._decode(group, found,
+                                   lambda b: pmk_host[:, b],
+                                   _ShiftedWords(u.words, u.lo), None, live)
+                by_unit[id(u)].extend(new)
+                founds.extend(new)
+            for f in founds:
+                self.remove(f)
+            if wb is not None and self.pmk_store is not None:
+                # Store write-back (consumer thread, post-fetch — lint
+                # rule DW108): each unit's slice of the fused miss PMK
+                # matrix lands under its own ESSID.
+                pmk_miss, entries = wb
+                pmk_host = jax.device_get(pmk_miss)
+                for key, mlo, nm, miss_words in entries:
+                    self.pmk_store.put(key, miss_words,
+                                       pmk_host[:, mlo:mlo + nm])
+        if on_batch is not None:
+            for u in fb.units:
+                on_batch(u.key, u.count, by_unit[id(u)])
+        self.stage_times["collect"] += time.perf_counter() - t0
+        return founds
 
     def crack_rules(self, words, rules, on_batch=None, skip: int = 0) -> list:
         """Rules attack with ON-DEVICE mangling (rules/device.py).

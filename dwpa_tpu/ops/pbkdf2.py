@@ -33,8 +33,14 @@ def pbkdf2_sha1_pmk(pw_words, salt_block_1, salt_block_2, iterations=4096):
     ``pw_words``: 16 uint32 arrays of shape [B] — zero-padded 64-byte HMAC
     key blocks (utils/bytesops.pack_passwords_be).
     ``salt_block_1/2``: the single pre-padded 16-word message block for
-    ``essid || INT32_BE(i)`` (i = 1, 2) — plain int lists, host-prepped via
-    ``utils.bytesops.padded_blocks(essid + pack('>I', i), 64 + len(essid) + 4)``.
+    ``essid || INT32_BE(i)`` (i = 1, 2).  Each word is either a plain int
+    (one ESSID for the whole batch — host-prepped via
+    ``utils.bytesops.padded_blocks(essid + pack('>I', i), 64 + len(essid) + 4)``)
+    or a uint32 array of shape [B] (PER-LANE salts: lane b hashes its own
+    ESSID — the mixed-ESSID fused batch path).  ``broadcast_to`` below is
+    the whole dispatch: a scalar word fans out across the batch, a [B]
+    word passes through unchanged, and the 4096-iteration loop never sees
+    the difference (the salt only enters via U1).
 
     Returns 8 uint32 arrays of shape [B]: the PMK as big-endian words.
     """

@@ -102,8 +102,15 @@ def pbkdf2_sha1_pmk_pallas(
     ``pw_words``: uint32[B, 16] zero-padded 64-byte HMAC key blocks
     (utils/bytesops.pack_passwords_be).  ``salt1``/``salt2``: uint32[16]
     pre-padded single-block salt messages for ``essid || INT32_BE(i)``
-    (models/m22000.essid_salt_blocks).  Returns uint32[8, B] PMK words,
-    bit-identical to ops/pbkdf2.pbkdf2_sha1_pmk.
+    (models/m22000.essid_salt_blocks), or uint32[B, 16] for PER-LANE
+    salts (mixed-ESSID fused batches: lane b hashes its own ESSID).  The
+    salt only enters the prologue's U1 computation — the first-iteration
+    message block changes from broadcast scalars to [B] columns — so the
+    register-resident 4096-iteration loop body, and with it the kernel's
+    register pressure, is byte-identical in both modes (the hardware
+    tile sweep from r3 carries over; re-sweeping is advisable but not
+    required).  Returns uint32[8, B] PMK words, bit-identical to
+    ops/pbkdf2.pbkdf2_sha1_pmk.
     """
     B = pw_words.shape[0]
     pw = [pw_words[:, i] for i in range(16)]
@@ -123,8 +130,17 @@ def pbkdf2_sha1_pmk_pallas(
     if prologue_compress is not None:
         kw = {"compress": prologue_compress}
     ist, ost = hmac_sha1_precompute(pw, **kw)
-    u1_t1 = hmac_sha1_blocks(ist, ost, [[salt1[i] for i in range(16)]], **kw)
-    u1_t2 = hmac_sha1_blocks(ist, ost, [[salt2[i] for i in range(16)]], **kw)
+    if salt1.ndim == 2:
+        # Per-lane salts: word i of lane b's first-iteration message is
+        # column i of the [B, 16] salt block — same U1 math, broadcast
+        # against [B] instead of from a scalar.
+        s1 = [[salt1[:, i] for i in range(16)]]
+        s2 = [[salt2[:, i] for i in range(16)]]
+    else:
+        s1 = [[salt1[i] for i in range(16)]]
+        s2 = [[salt2[i] for i in range(16)]]
+    u1_t1 = hmac_sha1_blocks(ist, ost, s1, **kw)
+    u1_t2 = hmac_sha1_blocks(ist, ost, s2, **kw)
 
     # Fold T into lanes: [2B] = T1 lanes then T2 lanes, padded to the tile.
     # Clamp the tile to the actual lane count (min 8 sublanes — the uint32

@@ -85,6 +85,37 @@ def pmk_step(mesh):
     return _STEP_CACHE[key]
 
 
+def fused_pmk_step(mesh):
+    """jitted ``(pw_words[B,16], unit_id[B], table1[U,16], table2[U,16])
+    -> pmk uint32[8, B]`` — the mixed-ESSID fused PBKDF2 step.
+
+    Each lane gathers its OWN salt blocks from the replicated per-unit
+    tables (``table[unit_id]``, a device-side [b, 16] gather on the
+    local shard) and the per-lane-salt PBKDF2 kernel runs unchanged —
+    the H2D cost of mixing ESSIDs in one batch is 4 bytes/lane of
+    ``unit_id``, not 128 bytes/lane of salt blocks.  Everything is
+    data: one compile serves every unit combination ever fused, keyed
+    only on the (bounded) lane-width/table-shape signature — callers
+    pad ``B`` to the static fused-width table (``sched.fuse``, lint
+    rule DW109) and ``U`` to the fixed ``fuse_max_units`` bucket
+    (repeat row 0), so the jit cache stays a handful of entries.
+    """
+    key = (mesh, "pmk_fused")
+    if key not in _STEP_CACHE:
+        use_pallas = all(d.platform == "tpu" for d in mesh.devices.flat)
+
+        def local(pw_words, unit_id, t1, t2):
+            return m._pmk_impl(pw_words, t1[unit_id], t2[unit_id],
+                               use_pallas=use_pallas)
+
+        _STEP_CACHE[key] = _shard(
+            mesh, local,
+            (P(DP_AXIS, None), P(DP_AXIS), P(), P()),
+            P(None, DP_AXIS),
+        )
+    return _STEP_CACHE[key]
+
+
 def _gate(found, mask):
     """found bool[N, V, b], mask bool[N] -> replicated exact hit count.
 

@@ -1,0 +1,269 @@
+"""Multi-unit executor: keep a queue of small work units ahead of the
+device and crack them as fused mixed-ESSID batches.
+
+The client's unit loop is strictly serial: fetch a unit, crack it, fetch
+the next — so a stream of small ESSID-group x dict units leaves the
+device idle between units AND underfilled within them.  This executor
+is the scheduling half of the fusion tentpole (``sched.fuse`` is the
+packing half): a producer thread materializes up to ``unit_queue``
+units ahead (skip applied — deterministic resume framing carries over),
+and the consumer drains them in WAVES of up to ``fuse_max_units``,
+handing each wave to ``M22000Engine.crack_fused`` which packs the
+units' candidates into full device batches with per-lane salt gather.
+
+Failure containment (the in-process recovery contract the client's
+``--unit-queue`` path relies on): a wave whose crack dispatch raises —
+device error, XLA OOM on an oversized fused width — is retried ONCE at
+half the batch size on a fresh engine; if it fails again its units are
+requeued with exponential backoff, and a unit that keeps failing lands
+in ``failed`` instead of wedging the stream.
+
+Observability: ``dwpa_fused_units_per_batch`` (histogram),
+``dwpa_fused_fill_fraction`` / ``dwpa_unit_queue_depth`` (gauges),
+``dwpa_fused_retries_total`` (counter), plus the engine's
+``sched:fuse`` / ``sched:demux`` spans when a tracer is wired.
+"""
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..feed.framing import skip_stream
+from ..models import hashline as hl
+
+#: Fused-batch histogram buckets: unit counts, not seconds (the metrics
+#: registry's DEFAULT_BUCKETS are latency-oriented).
+UNITS_PER_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0)
+
+
+@dataclass
+class WorkUnit:
+    """One fetchable work unit: a hashline set and a candidate stream.
+
+    ``words`` may be any iterable; the producer thread materializes it
+    (after dropping ``skip`` candidates — the resume contract: a unit
+    retried or resumed at skip=k behaves exactly like the serial path's
+    ``skip_stream``).  ``consumed`` is the unit's conservative resume
+    floor: the minimum candidate coverage across its ESSID parts, so a
+    checkpoint written from it never skips an uncracked candidate.
+    """
+
+    uid: object
+    lines: list
+    words: object
+    skip: int = 0
+    attempts: int = 0
+    consumed: int = 0
+    founds: list = field(default_factory=list)
+    # -- producer/consumer internals --
+    _materialized: list = None
+    _essids: tuple = None
+    _done: dict = None
+
+    def essids(self) -> tuple:
+        """The unit's distinct ESSIDs, parse-tolerant (unparseable
+        lines are the engine's ``skipped`` concern, not a wave killer)."""
+        if self._essids is None:
+            seen = {}
+            for line in self.lines:
+                try:
+                    h = line if isinstance(line, hl.Hashline) else hl.parse(line)
+                except ValueError:
+                    continue
+                seen[h.essid] = True
+            self._essids = tuple(seen)
+        return self._essids
+
+
+class MultiUnitExecutor:
+    """Pack small work units into fused device batches (see module doc).
+
+    ``units``: iterable of ``WorkUnit`` (a generator is fine — the
+    producer thread pulls lazily, so fetch latency overlaps cracking).
+    ``engine_factory(lines, batch_size)``: override for tests; defaults
+    to building an ``M22000Engine`` with this executor's mesh/store.
+    """
+
+    def __init__(self, units, *, batch_size=4096, unit_queue=4,
+                 fuse_max_units=8, nc=8, mesh="auto", pmk_store=None,
+                 registry=None, tracer=None, max_retries=2,
+                 backoff_s=1.0, sleep=time.sleep, engine_factory=None,
+                 verify_with_oracle=True):
+        self.units = iter(units)
+        self.batch_size = int(batch_size)
+        self.unit_queue = max(1, int(unit_queue))
+        self.fuse_max_units = max(1, int(fuse_max_units))
+        self.nc = nc
+        self.mesh = mesh
+        self.pmk_store = pmk_store
+        self.registry = registry
+        self.tracer = tracer
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.sleep = sleep
+        self.verify_with_oracle = verify_with_oracle
+        self._engine_factory = engine_factory or self._default_engine
+        self.done = []     # units that completed (possibly after retry)
+        self.failed = []   # units abandoned after max_retries
+        self._q = queue.Queue(maxsize=self.unit_queue)
+        self._deferred = []  # essid-collision holdovers, next-wave first
+        self._producer_err = None
+        if registry is not None:
+            self._m_units = registry.histogram(
+                "dwpa_fused_units_per_batch",
+                "Work units packed into each fused device batch",
+                buckets=UNITS_PER_BATCH_BUCKETS)
+            self._m_fill = registry.gauge(
+                "dwpa_fused_fill_fraction",
+                "Real-candidate fraction of the last fused batch")
+            self._m_depth = registry.gauge(
+                "dwpa_unit_queue_depth",
+                "Prefetched work units waiting in the executor queue")
+            self._m_retries = registry.counter(
+                "dwpa_fused_retries_total",
+                "Fused wave crack attempts retried after an engine error")
+        else:
+            self._m_units = self._m_fill = self._m_depth = None
+            self._m_retries = None
+
+    # -- producer ----------------------------------------------------------
+
+    def _produce(self):
+        """Materialize units ahead of the consumer (bounded queue).
+
+        Pure host work — candidate IO and skip framing — so it overlaps
+        device compute exactly like the feed's producer threads."""
+        try:
+            for u in self.units:
+                words = iter(u.words)
+                if u.skip:
+                    skip_stream(words, u.skip)  # consumes in place
+                u._materialized = list(words)
+                self._q.put(u)
+                self._gauge_depth()
+        except BaseException as e:  # surfaced on the consumer side
+            self._producer_err = e
+        finally:
+            self._q.put(None)
+
+    def _gauge_depth(self):
+        if self._m_depth is not None:
+            self._m_depth.set(self._q.qsize())
+
+    # -- consumer ----------------------------------------------------------
+
+    def _default_engine(self, lines, batch_size):
+        from ..models.m22000 import M22000Engine
+
+        return M22000Engine(lines, nc=self.nc, batch_size=batch_size,
+                            mesh=self.mesh, pmk_store=self.pmk_store,
+                            verify_with_oracle=self.verify_with_oracle)
+
+    def _next_wave(self, exhausted):
+        """Assemble the next wave: deferred holdovers first, then fresh
+        units from the queue, stopping at ``fuse_max_units`` or at an
+        ESSID collision (two units sharing an ESSID cannot share a
+        fused batch's salt table — the collider waits one wave)."""
+        wave, taken = [], set()
+
+        def try_add(u):
+            es = u.essids()
+            if any(e in taken for e in es):
+                return False
+            wave.append(u)
+            taken.update(es)
+            return True
+
+        held, self._deferred = self._deferred, []
+        for u in held:
+            if len(wave) >= self.fuse_max_units or not try_add(u):
+                self._deferred.append(u)
+        while len(wave) < self.fuse_max_units and not exhausted[0]:
+            try:
+                u = self._q.get(block=not wave and not self._deferred)
+            except queue.Empty:
+                break
+            if u is None:
+                exhausted[0] = True
+                break
+            self._gauge_depth()
+            if not try_add(u):
+                self._deferred.append(u)
+                break  # keep wave assembly cheap; collider leads next wave
+        return wave
+
+    def _run_wave(self, wave, batch_size):
+        """Crack one wave through a fresh engine's fused path."""
+        lines = [ln for u in wave for ln in u.lines]
+        engine = self._engine_factory(lines, batch_size)
+        by_essid = {}
+        for u in wave:
+            u._done = {}
+            for e in u.essids():
+                by_essid[e] = u
+        parts = [(e, u._materialized) for u in wave for e in u.essids()]
+
+        def on_batch(essid, consumed, founds):
+            u = by_essid.get(essid)
+            if u is None:
+                return
+            u._done[essid] = u._done.get(essid, 0) + consumed
+            # Conservative resume floor across the unit's ESSID parts.
+            u.consumed = u.skip + min(u._done.values())
+            for f in founds:
+                if all(f.line is not g.line or f.psk != g.psk
+                       for g in u.founds):
+                    u.founds.append(f)
+
+        def on_fused(fb):
+            if self._m_units is not None:
+                self._m_units.observe(len(fb.units))
+                self._m_fill.set(fb.fill)
+
+        engine.crack_fused(parts, on_batch=on_batch,
+                           max_units=self.fuse_max_units,
+                           tracer=self.tracer, on_fused=on_fused)
+
+    def run(self) -> list:
+        """Drain every unit; returns the completed units in finish order.
+
+        Engine errors are contained per wave: one retry at half batch,
+        then requeue-with-backoff, then ``failed`` (module doc)."""
+        producer = threading.Thread(target=self._produce, daemon=True,
+                                    name="sched-unit-producer")
+        producer.start()
+        exhausted = [False]
+        while True:
+            wave = self._next_wave(exhausted)
+            if not wave:
+                if exhausted[0] and not self._deferred:
+                    break
+                continue
+            try:
+                self._run_wave(wave, self.batch_size)
+            except RuntimeError:
+                # Satellite recovery: one in-process retry at half batch
+                # (an XLA OOM on the fused width usually fits at W/2;
+                # a transient device error just needs the re-dispatch).
+                if self._m_retries is not None:
+                    self._m_retries.inc()
+                try:
+                    self._run_wave(wave, max(1, self.batch_size // 2))
+                except RuntimeError:
+                    requeued = False
+                    for u in wave:
+                        u.attempts += 1
+                        if u.attempts > self.max_retries:
+                            self.failed.append(u)
+                        else:
+                            self._deferred.append(u)
+                            requeued = True
+                    if requeued:
+                        self.sleep(self.backoff_s * 2 ** (wave[0].attempts - 1))
+                    continue
+            self.done.extend(wave)
+        producer.join(timeout=5)
+        if self._producer_err is not None:
+            raise self._producer_err
+        return self.done

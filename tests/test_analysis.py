@@ -642,6 +642,91 @@ def test_dw108_real_pmkstore_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# DW109: fused-pad-width discipline
+# ---------------------------------------------------------------------------
+
+FUSE_PATH = "dwpa_tpu/sched/fuse.py"
+
+
+def test_dw109_data_dependent_pad_width():
+    """The seeded failure mode: padding the per-lane row buffer to the
+    candidate COUNT instead of the static table — every unit mix would
+    retrace the fused PMK step."""
+    src = """
+        import numpy as np
+
+        def pack(parts, batch, n):
+            total = sum(len(w) for _, w in parts)
+            rows = np.zeros((total, 16), np.uint32)
+            return rows
+    """
+    vs = lint(src, FUSE_PATH)
+    assert codes(vs) == ["DW109"]
+    assert "static fused-width pad table" in vs[0].detail
+    # scoped to the fused-batch packers: elsewhere the same source is clean
+    assert lint(src, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw109_arithmetic_on_count_and_empty_flag():
+    vs = lint("""
+        import numpy as np
+
+        def pack(nmiss, n):
+            W = -(-nmiss // n) * n
+            rows = np.empty((W, 16), dtype=np.uint32)
+            return rows
+    """, "dwpa_tpu/pmkstore/stage.py")
+    assert codes(vs) == ["DW109"]
+
+
+def test_dw109_table_widths_clean():
+    """Every accepted width shape at once: the table call, a subscript
+    of the table, a conditional over accepted branches, and a name whose
+    assignments all resolve to the table."""
+    vs = lint("""
+        import numpy as np
+
+        def pack(parts, batch, n, total, nmiss, all_miss):
+            W = fused_width(batch, n, total)
+            rows = np.zeros((W, 16), np.uint32)
+            Wm = W if all_miss else fused_width(batch, n, max(nmiss, 1))
+            miss_rows = np.zeros((Wm, 16), np.uint32)
+            smallest = fused_widths(batch, n)[0]
+            probe = np.zeros((smallest, 16), np.uint32)
+            fixed = np.zeros((8, 16), np.uint32)
+            return rows, miss_rows, probe, fixed
+    """, FUSE_PATH)
+    assert vs == []
+
+
+def test_dw109_non_row_buffers_out_of_scope():
+    """Only [W, 16] row buffers are policed — 1-D lane vectors and
+    other-width allocations are not pmk_kernel inputs."""
+    vs = lint("""
+        import numpy as np
+
+        def pack(total):
+            unit_id = np.zeros(total, np.int32)
+            lens = np.zeros((total,), np.uint8)
+            pmks = np.zeros((8, total), np.uint32)
+            return unit_id, lens, pmks
+    """, FUSE_PATH)
+    assert vs == []
+
+
+def test_dw109_real_fused_packers_are_clean():
+    """The shipped packers obey their own discipline — proven against
+    the real tree, not a fixture."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    for rel in ("dwpa_tpu/sched/fuse.py", "dwpa_tpu/pmkstore/stage.py"):
+        path = os.path.join(root, *rel.split("/"))
+        assert [v for v in lint_file(path, root)
+                if v.code == "DW109"] == [], rel
+
+
+# ---------------------------------------------------------------------------
 # recompilation sentinel
 # ---------------------------------------------------------------------------
 
@@ -900,7 +985,7 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
-             "DW108", "DW201", "DW202", "DW203", "DW204"}
+             "DW108", "DW109", "DW201", "DW202", "DW203", "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

@@ -616,6 +616,79 @@ def test_client_cli_multihost_flags():
         assert e.value.code == 2  # argparse usage error
 
 
+def test_fused_metrics_in_scrape_and_executor_wiring(server, tmp_path):
+    """Unit-fusion telemetry contract: the dwpa_fused_* family and the
+    engine-retry counter are registered up front (names visible in the
+    ?metrics scrape before any fused batch runs), and fused_executor()
+    binds the client's knobs/registry/tracer/store."""
+    reg = MetricsRegistry()
+    client = _client(server, tmp_path, registry=reg,
+                     unit_queue=3, fuse_max_units=4)
+    text = reg.render_prometheus()
+    for name in ("dwpa_fused_units_per_batch", "dwpa_fused_fill_fraction",
+                 "dwpa_unit_queue_depth", "dwpa_client_engine_retries_total"):
+        assert name in text, name
+    ex = client.fused_executor([])
+    assert ex.batch_size == client.cfg.batch_size
+    assert ex.unit_queue == 3 and ex.fuse_max_units == 4
+    assert ex.registry is reg and ex.tracer is client.tracer
+    assert ex.pmk_store is client.pmk_store
+
+
+def test_engine_error_recovery_halves_batch(server, tmp_path):
+    """In-process engine recovery: a crack dispatch that raises is
+    retried once at half the batch — with the _progress checkpoint
+    dropped first, since skip-by-count is unsound across a batch-size
+    change — and the unit completes without touching the retry loop."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="er1")])
+    _add_dict(server, [PSK])
+    reg = MetricsRegistry()
+    client = _client(server, tmp_path, registry=reg)
+    work = client.api.get_work(1)
+    work["_progress"] = {"done": 0, "cand": []}
+    seen = []
+    real = client.process_work
+
+    def flaky(w):
+        seen.append((client.cfg.batch_size, "_progress" in w))
+        if len(seen) == 1:
+            raise RuntimeError("injected XLA OOM")
+        return real(w)
+
+    client.process_work = flaky
+    res = client._process_with_recovery(work)
+    assert res is not None and res.accepted
+    assert seen == [(64, True), (32, False)]
+    assert client.cfg.batch_size == 64  # restored after the retry
+    assert reg.value("dwpa_client_engine_retries_total") == 1
+
+
+def test_engine_error_persistent_requeues_then_abandons(server, tmp_path):
+    """Both recovery attempts failing requeues the unit with backoff via
+    the resume file; ENGINE_RETRY_LIMIT total attempts abandon it."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="er2")])
+    _add_dict(server, [PSK])
+    client = _client(server, tmp_path)
+    slept = []
+    client.api.sleep = slept.append
+    work = client.api.get_work(1)
+
+    def boom(w):
+        raise RuntimeError("device fell off the bus")
+
+    client.process_work = boom
+    assert client._process_with_recovery(work) is None
+    assert work["_attempts"] == 1
+    assert client.cfg.batch_size == 64  # restored before the resume stamp
+    assert slept == [client.api.backoff]
+    assert client._read_resume() == work  # requeued for the next loop pass
+    assert client._process_with_recovery(work) is None
+    assert client._process_with_recovery(work) is None
+    assert work["_attempts"] == client.ENGINE_RETRY_LIMIT
+    assert len(slept) == 2  # the abandoning attempt does not back off
+    assert client._read_resume() is None  # abandoned, not wedged
+
+
 def test_bundled_wpa_rules_crack_mangled_psk(server, tmp_path):
     """A dict packed with the bundled WPA ruleset cracks a PSK that is a
     base word through a rule ('c $1'), end-to-end over the wire — the
