@@ -473,6 +473,124 @@ def bench_small_units(nunits: int = 8, words_per_unit: int = 1000,
             "recompiles": comp.count}
 
 
+def bench_device_streams(batch: int = None, batches: int = 12) -> dict:
+    """bench:device_streams — lockstep DP dispatch vs per-device streams.
+
+    Leg 1 cracks a framed stream the lockstep way: every block split
+    1/ndev across the ``shard_map`` mesh, a psum hits-gate barriering
+    all devices per batch.  Leg 2 cracks the SAME stream with the
+    device-stream executor (dwpa_tpu/parallel/streams.py): each device
+    runs whole blocks on its own single-device engine, pulled from a
+    shared queue — identical founds, no cross-device collective.  The
+    compile sentinel wraps the warm streams leg at 0.
+
+    The straggler pair quantifies the executor's headline property.
+    Run A: all streams crack junk blocks at their natural rate.  Run B:
+    stream 0's engine is wrapped to dawdle on every collect.  Because
+    streams share nothing but the queue, the other streams' BUSY rate
+    (blocks per second not spent waiting on the queue) must hold —
+    ``min_retained`` is the worst non-straggler B/A busy-rate ratio and
+    the acceptance floor is 0.9.  Under lockstep the same wrap would
+    drag every device to the straggler's pace.
+    """
+    import time as _time
+
+    from dwpa_tpu.feed import frame_blocks
+    from dwpa_tpu.parallel import StreamExecutor, default_mesh
+
+    batch = batch or (131072 if ON_TPU else 2048)
+    # equal device width on both legs: lockstep splits each block over
+    # the full mesh, streams give each of the same devices whole blocks
+    devices = list(jax.devices())
+    nstreams = len(devices)
+
+    def make_lines(tag):
+        # three ESSID groups: the forced-host CPU lockstep leg stalls
+        # its AllReduce rendezvous when too many collective-bearing
+        # steps are in flight (seen from ~7 groups); streams don't care
+        return [T.make_pmkid_line(b"streampass-%d" % i,
+                                  b"bench-stream-%s-%d" % (tag, i),
+                                  seed=f"ds-{tag.decode()}-{i}")
+                for i in range(3)]
+
+    n = batch * batches
+    words = [b"dsjunk-%08d" % i for i in range(n)]
+    for i in range(3):              # plant each PSK in a different block
+        words[batch * (i * batches // 3) + 17 + i] = b"streampass-%d" % i
+
+    # Warm both legs' shapes outside the timed regions (junk words so
+    # the warm engines never prune).
+    warm_words = [b"dswarm-%07d" % i for i in range(batch)]
+    M22000Engine(make_lines(b"wl"), batch_size=batch).crack(warm_words)
+    M22000Engine(make_lines(b"ws"), batch_size=batch).crack_streams(
+        frame_blocks(iter(warm_words * nstreams), batch), devices=devices)
+
+    lock_eng = M22000Engine(make_lines(b"run"), batch_size=batch)
+    with TRACER.span("bench:device_streams_lockstep") as sp:
+        lock_founds = lock_eng.crack_blocks(
+            frame_blocks(iter(words), lock_eng.batch_size))
+    lock_s = sp.seconds
+
+    st_eng = M22000Engine(make_lines(b"run"), batch_size=batch)
+    with watch_compiles() as comp:
+        with TRACER.span("bench:device_streams") as sp:
+            st_founds = st_eng.crack_streams(
+                frame_blocks(iter(words), st_eng.batch_size),
+                devices=devices)
+    streams_s = sp.seconds
+    founds_identical = (
+        sorted((f.line.essid, f.psk) for f in st_founds)
+        == sorted((f.line.essid, f.psk) for f in lock_founds))
+    assert founds_identical, "streams leg's founds differ from lockstep"
+    assert len(st_founds) == 3, "a planted PSK was missed"
+
+    # Straggler pair: same junk workload, run B wraps stream 0's engine.
+    drag = max(0.02, lock_s / batches)
+    sblocks = 4 * nstreams
+
+    class _Dawdle:
+        def __init__(self, eng):
+            self._eng = eng
+
+        def __getattr__(self, name):
+            return getattr(self._eng, name)
+
+        def _collect(self, disp):
+            _time.sleep(drag)
+            return self._eng._collect(disp)
+
+    def busy_rates(straggle):
+        def factory(device):
+            eng = M22000Engine(make_lines(b"st"), batch_size=batch,
+                               mesh=default_mesh(devices=[device]))
+            if straggle and device is devices[0]:
+                return _Dawdle(eng)
+            return eng
+
+        ex = StreamExecutor(factory, devices)
+        t0 = _time.perf_counter()
+        ex.run(frame_blocks(iter(b"stjunk-%08d" % i
+                                 for i in range(batch * sblocks)), batch))
+        wall = _time.perf_counter() - t0
+        return [st.blocks_done / max(1e-9, wall - st.wait_s)
+                for st in ex.streams]
+
+    rates_a = busy_rates(False)
+    rates_b = busy_rates(True)
+    retained = [rates_b[i] / rates_a[i] for i in range(1, nstreams)]
+
+    return {"label": "device_streams", "batch": batch, "batches": batches,
+            "streams": nstreams,
+            "lockstep_seconds": lock_s, "streams_seconds": streams_s,
+            "lockstep_pmk_per_s": n / lock_s,
+            "streams_pmk_per_s": n / streams_s,
+            "aggregate_speedup": lock_s / streams_s,
+            "founds_identical": founds_identical,
+            "straggler_drag_s": drag,
+            "min_retained": min(retained), "retained": retained,
+            "recompiles_warm": comp.count}
+
+
 def _timed(fn, name: str = "bench:timed") -> float:
     """One rep as a span: the body must sync its own device work (every
     caller passes an engine crack* call, which does)."""
@@ -593,6 +711,7 @@ def main():
     feed_ov = bench_feed_overlap(batch)
     pmkstore = bench_pmkstore(batch)
     small_units = bench_small_units()
+    streams = bench_device_streams()
     overhead = bench_unit_overhead(pmkid)
 
     value = mask["pmk_per_s"]
@@ -617,6 +736,7 @@ def main():
                     "feed_overlap": _round(feed_ov),
                     "pmkstore": _round(pmkstore),
                     "small_units": _round(small_units),
+                    "device_streams": _round(streams),
                     "unit_overhead": _round(overhead),
                 },
             }

@@ -69,6 +69,19 @@ Rule codes (stable — referenced by baseline.json and the docs):
   ``pmk_kernel`` at a data-dependent width would retrace the PBKDF2
   step per unit combination — the compile-per-work-unit failure the
   width tables exist to prevent (recompile-sentinel proof in tests).
+- **DW110 stream-isolation** — the device-stream contract
+  (``parallel/streams.py``, see ``STREAM_FILES``), three shapes: (a) a
+  cross-device collective (``psum``/``all_gather``/...) anywhere in the
+  file — a stream owns exactly one device, and a collective would
+  barrier it against its siblings, reintroducing the lockstep coupling
+  streams exist to remove (and deadlocking outright when streams run
+  different block counts); (b) a blocking device→host fetch
+  (``jax.device_get``/``block_until_ready``) inside a ``for``/``while``
+  loop — the per-stream dispatch loop must stay async, its only sync
+  being the engine's own hits-gate inside ``_collect``; (c) a
+  ``device_put`` without an explicit device/sharding argument — a bare
+  put lands on the default device, silently stacking every stream's
+  arrays onto device 0 instead of the stream's own chip.
 - **DW106 telemetry-discipline** — the obs-layer contract, two shapes:
   (a) a metric/span emission call (``.inc()``/``.dec()``/``.set()``/
   ``.observe()``, excluding jnp's ``x.at[i].set(v)`` functional update)
@@ -148,8 +161,21 @@ _BAD_DTYPES = {
 SYNC_MARKERS = {
     "block_until_ready", "asarray", "item", "array",
     "crack", "crack_batch", "crack_rules", "crack_mask", "crack_blocks",
-    "crack_fused",
+    "crack_fused", "crack_streams", "run_blocks",
 }
+
+#: files holding per-device stream executors DW110 polices — a stream
+#: owns ONE device, so nothing in it may span devices or barrier
+STREAM_FILES = ("dwpa_tpu/parallel/streams.py",)
+#: cross-device collectives DW110 bans anywhere in STREAM_FILES
+STREAM_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "psum_scatter",
+}
+#: blocking device→host fetches DW110 bans inside a stream's
+#: dispatch/pull loops (the only allowed sync is the engine's own
+#: hits-gate inside ``_collect``)
+STREAM_BLOCKING_FETCHES = {"device_get", "block_until_ready"}
 
 #: files whose [W, 16] row-buffer allocations DW109 polices — the
 #: fused/mixed batch packers that feed per-lane rows to pmk_kernel
@@ -853,6 +879,58 @@ def _check_fused_pad_widths(tree, path, src_lines, out):
                     _line(src_lines, node)))
 
 
+def _check_stream_discipline(tree, path, src_lines, out):
+    """DW110: per-device stream isolation (``STREAM_FILES``).
+
+    (a) no cross-device collective anywhere in the file — a stream owns
+    one device, and a ``psum``/``all_gather`` would barrier it against
+    its siblings (or deadlock when streams run different block counts);
+    (b) no blocking ``jax.device_get``/``block_until_ready`` inside a
+    ``for``/``while`` loop — the dispatch/pull loops stay async, the
+    only sync being the engine's hits-gate inside ``_collect``; (c)
+    every ``device_put`` carries an explicit device/sharding (second
+    positional or ``device=``/``sharding=`` kwarg) — a bare put lands
+    every stream's arrays on the default device."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in STREAM_COLLECTIVES:
+            out.append(Violation(
+                "DW110", path, node.lineno,
+                f"cross-device collective {name}() in a device-stream "
+                "module — a stream owns one device; a collective "
+                "barriers it against its siblings (lockstep coupling, "
+                "or deadlock on uneven block counts)",
+                _line(src_lines, node)))
+        elif name == "device_put":
+            explicit = len(node.args) >= 2 or any(
+                kw.arg in ("device", "sharding") for kw in node.keywords)
+            if not explicit:
+                out.append(Violation(
+                    "DW110", path, node.lineno,
+                    "device_put without an explicit device/sharding — "
+                    "a bare put lands on the default device, stacking "
+                    "every stream's arrays onto device 0",
+                    _line(src_lines, node)))
+    seen = set()  # nested loops are walked by their enclosing loop too
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if (id(node) in seen or not isinstance(node, ast.Call)
+                    or _call_name(node) not in STREAM_BLOCKING_FETCHES):
+                continue
+            seen.add(id(node))
+            out.append(Violation(
+                "DW110", path, node.lineno,
+                f"blocking {_call_name(node)}() inside a stream loop — "
+                "the per-stream dispatch loop must stay async; the "
+                "only allowed sync is the engine's hits-gate inside "
+                "_collect",
+                _line(src_lines, node)))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -885,6 +963,8 @@ def lint_source(src: str, path: str) -> list:
         _check_pmkstore_writeback(tree, path, src_lines, out)
     if path in FUSED_PAD_FILES:
         _check_fused_pad_widths(tree, path, src_lines, out)
+    if path in STREAM_FILES:
+        _check_stream_discipline(tree, path, src_lines, out)
     return out
 
 

@@ -32,10 +32,17 @@ def build_parser():
     p.add_argument("--feed-depth", type=int, default=2,
                    help="candidate-feed queue depth: blocks framed/packed "
                         "ahead of the engine (README 'Candidate feed')")
-    p.add_argument("--feed-workers", type=int, default=1,
+    p.add_argument("--feed-workers", type=int, default=None,
                    help="candidate-feed producer threads running the host "
-                        "stages off the crack loop (0 = inline feed, no "
-                        "threads)")
+                        "stages off the crack loop (default: one per local "
+                        "device, so every device stream keeps a producer; "
+                        "0 = inline feed, no threads)")
+    p.add_argument("--device-streams", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="independent per-device crack streams instead of "
+                        "lockstep shard_map dispatch (README 'Device "
+                        "streams'); auto = on for single-process "
+                        "multi-device, lockstep otherwise")
     p.add_argument("--pmk-cache-dir",
                    help="persistent PMK store directory: cross-unit "
                         "PBKDF2->PMK cache with mixed hit/miss crack "
@@ -100,6 +107,7 @@ def main(argv=None):
         pmk_cache_max_bytes=args.pmk_cache_max_bytes,
         unit_queue=args.unit_queue,
         fuse_max_units=args.fuse_max_units,
+        device_streams=args.device_streams,
     )
     TpuCrackClient(cfg).run()
 

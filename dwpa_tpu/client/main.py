@@ -148,8 +148,10 @@ class ClientConfig:
                                     # mangles on device (0 = inline)
     feed_depth: int = 2             # candidate-feed queue depth (blocks
                                     # framed ahead of the engine)
-    feed_workers: int = 1           # candidate-feed producer threads
-                                    # (0 = inline/synchronous feed)
+    feed_workers: int = None        # candidate-feed producer threads
+                                    # (None = one per local device,
+                                    # parallel.streams.default_feed_workers;
+                                    # 0 = inline/synchronous feed)
     archive: bool = True            # append-only archive.22000/archive.res
                                     # audit logs (DAW, help_crack.py:453-456)
     pmk_cache_dir: str = None       # --pmk-cache-dir: persistent cross-unit
@@ -163,6 +165,11 @@ class ClientConfig:
     fuse_max_units: int = 8         # --fuse-max-units: max work units
                                     # packed into one fused device batch
                                     # (one salt-table row per ESSID)
+    device_streams: str = "auto"    # --device-streams: independent
+                                    # per-device crack streams vs lockstep
+                                    # shard_map dispatch ("auto": streams
+                                    # on single-process multi-device,
+                                    # lockstep elsewhere; "on"/"off" force)
 
 
 @dataclass
@@ -232,6 +239,16 @@ class TpuCrackClient:
                   "Real-candidate fraction of the last fused batch")
         reg.gauge("dwpa_unit_queue_depth",
                   "Prefetched work units waiting in the executor queue")
+        # Device-stream families (parallel/streams.py) — same up-front
+        # registration so the scrape surface is stable; the per-device
+        # labeled series appear once the first stream dispatches.
+        reg.counter("dwpa_stream_blocks_total",
+                    "Feed blocks completed per device stream")
+        reg.gauge("dwpa_stream_busy_fraction",
+                  "Per-stream fraction of wall time spent in "
+                  "prepare/dispatch/collect (1 - shared-queue wait)")
+        reg.gauge("dwpa_stream_queue_depth",
+                  "Shared work-queue depth at this stream's last pull")
         if config.additional_dict and jax.process_count() > 1:
             # A per-host local file cannot feed a multi-host slice: the
             # pass-1 streams must be byte-identical on every host or the
@@ -328,6 +345,42 @@ class TpuCrackClient:
             self.prewarm()
         return ok
 
+    # -- device-stream plumbing (parallel/streams.py) ----------------------
+
+    def _feed_workers(self) -> int:
+        """Configured producer count, defaulting to one per local device
+        so an N-stream mesh never starves behind a single producer."""
+        if self.cfg.feed_workers is not None:
+            return self.cfg.feed_workers
+        from ..parallel.streams import default_feed_workers
+
+        return default_feed_workers()
+
+    def _use_streams(self) -> bool:
+        """Whether bulk passes run as independent device streams
+        (``crack_streams``) instead of lockstep dispatch: "on"/"off"
+        force it; "auto" follows ``streams_default()`` — streams on
+        single-process multi-device, lockstep on multi-host slices
+        (where the global hits-gate is genuinely needed) and on a
+        single chip (where they are the same thing)."""
+        mode = self.cfg.device_streams
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        from ..parallel.streams import streams_default
+
+        return streams_default()
+
+    def _crack_blocks(self, engine, feed, on_batch=None):
+        """Route one framed block stream through streams or lockstep,
+        preserving the ``on_batch`` resume contract either way."""
+        if self._use_streams():
+            return engine.crack_streams(feed, on_batch=on_batch,
+                                        registry=self.registry,
+                                        tracer=self.tracer)
+        return engine.crack_blocks(feed, on_batch=on_batch)
+
     def prewarm(self):
         """Compile (or cache-load) the work-sized crack steps behind the
         challenge gate, so the first work unit never stalls on XLA.
@@ -371,11 +424,13 @@ class TpuCrackClient:
         )
         feed = CandidateFeed(warm_words, batch_size=n,
                              depth=self.cfg.feed_depth,
-                             producers=self.cfg.feed_workers,
+                             producers=self._feed_workers(),
                              prepack=eng.host_packer(),
                              registry=self.registry, name="prewarm")
         try:
-            eng.crack_blocks(feed)
+            # Streams mode warms the per-device single-mesh engines (the
+            # shapes real units hit); lockstep warms the shard_map path.
+            self._crack_blocks(eng, feed)
         finally:
             feed.close()
         if jax.process_count() == 1:
@@ -766,7 +821,7 @@ class TpuCrackClient:
         # rule DW107 documents.
         rules = self._rules(work)
         cfg_feed = dict(depth=self.cfg.feed_depth,
-                        producers=self.cfg.feed_workers,
+                        producers=self._feed_workers(),
                         registry=self.registry)
         self._snapshot_prdict(work)
         # The compile sentinel wraps both passes: a steady-state unit
@@ -784,7 +839,7 @@ class TpuCrackClient:
                     pid=0, prepack=engine.host_packer(), name="pass1",
                     **cfg_feed)
                 try:
-                    engine.crack_blocks(feed1, on_batch=on_batch)
+                    self._crack_blocks(engine, feed1, on_batch=on_batch)
                     # actually-skipped count (< skip on a short stream);
                     # the remainder of the resume window carries into
                     # pass 2.  The skip ran before any framing, so this
@@ -839,7 +894,7 @@ class TpuCrackClient:
                         prepack=engine.host_packer(), name="pass2",
                         **cfg_feed)
                     try:
-                        engine.crack_blocks(feed2, on_batch=on_batch)
+                        self._crack_blocks(engine, feed2, on_batch=on_batch)
                     finally:
                         feed2.close()
         tried = done - skip
@@ -934,7 +989,9 @@ class TpuCrackClient:
             unit_queue=self.cfg.unit_queue,
             fuse_max_units=self.cfg.fuse_max_units,
             nc=self.cfg.nc, pmk_store=self.pmk_store,
-            registry=self.registry, tracer=self.tracer)
+            registry=self.registry, tracer=self.tracer,
+            streams="auto" if self.cfg.device_streams == "auto"
+            else self._use_streams())
 
     #: In-process crack attempts per work unit before the unit is
     #: abandoned (attempt 1 at the configured batch, each retry attempt

@@ -1235,6 +1235,57 @@ class M22000Engine:
         pipe.drain()
         return pipe.founds
 
+    def crack_streams(self, blocks, on_batch=None, *, devices=None,
+                      registry=None, tracer=None, engine_factory=None,
+                      max_attempts=2) -> list:
+        """Crack a framed block stream as independent device streams.
+
+        The stream twin of ``crack_blocks`` (``parallel/streams.py``):
+        instead of splitting every block 1/ndev across a lockstep
+        ``shard_map`` mesh, each local device gets its own single-device
+        engine and crunches WHOLE blocks pulled from a shared queue —
+        no per-batch collective, no global barrier, so a straggler only
+        slows its own stream.  ``on_batch(consumed, founds)`` keeps the
+        ``crack_blocks`` contract exactly: one call per block, in
+        global stream order, with the block's global count — resume
+        framing is unchanged.  Found lists match the lockstep path's
+        (ordered demux dedups by net; first block wins).
+
+        Single-process only: a multi-host slice needs the lockstep
+        global hits-gate (every host must agree a batch is finished) —
+        ``parallel.streams.streams_default()`` is the switch the client
+        uses.  ``engine_factory(device)`` overrides the per-stream
+        engine for tests/benches; the default builds this engine's twin
+        over a 1-device mesh, sharing the SAME hashline objects so a
+        find on one stream prunes the net on every other.
+        """
+        from ..parallel.streams import StreamExecutor
+
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "crack_streams is single-process only — multi-host slices "
+                "keep the lockstep shard_map path (parallel/streams.py)")
+        if devices is None:
+            devices = list(self.mesh.devices.flat)
+        lines = [n.line for n in self.nets]
+
+        def _default_factory(device):
+            from ..parallel import default_mesh
+
+            return type(self)(
+                lines, nc=self.nc, batch_size=self.batch_size,
+                verify_with_oracle=self.verify_with_oracle,
+                mesh=default_mesh(devices=[device]),
+                pmk_store=self.pmk_store)
+
+        ex = StreamExecutor(engine_factory or _default_factory, devices,
+                            registry=registry, tracer=tracer,
+                            max_attempts=max_attempts)
+        founds = ex.run(blocks, on_batch=on_batch)
+        for f in founds:
+            self.remove(f)  # keep this (parent) engine's live view in sync
+        return founds
+
     def crack_fused(self, parts, on_batch=None, max_units=8, tracer=None,
                     on_fused=None) -> list:
         """Crack several small work units as fused mixed-ESSID batches.

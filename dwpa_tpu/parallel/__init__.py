@@ -2,3 +2,6 @@
 
 from .mesh import default_mesh, multihost_mesh, shard_candidates  # noqa: F401
 from .step import build_crack_step  # noqa: F401
+from .streams import (  # noqa: F401
+    DeviceStream, StreamError, StreamExecutor, default_feed_workers,
+    streams_default)

@@ -89,7 +89,7 @@ class MultiUnitExecutor:
                  fuse_max_units=8, nc=8, mesh="auto", pmk_store=None,
                  registry=None, tracer=None, max_retries=2,
                  backoff_s=1.0, sleep=time.sleep, engine_factory=None,
-                 verify_with_oracle=True):
+                 verify_with_oracle=True, streams="auto"):
         self.units = iter(units)
         self.batch_size = int(batch_size)
         self.unit_queue = max(1, int(unit_queue))
@@ -103,6 +103,10 @@ class MultiUnitExecutor:
         self.backoff_s = float(backoff_s)
         self.sleep = sleep
         self.verify_with_oracle = verify_with_oracle
+        #: "auto" resolves per-run via parallel.streams.streams_default():
+        #: single-process multi-device waves scatter over device streams
+        #: (one chip per bundle) instead of padding the whole mesh.
+        self.streams = streams
         self._engine_factory = engine_factory or self._default_engine
         self.done = []     # units that completed (possibly after retry)
         self.failed = []   # units abandoned after max_retries
@@ -153,12 +157,39 @@ class MultiUnitExecutor:
 
     # -- consumer ----------------------------------------------------------
 
-    def _default_engine(self, lines, batch_size):
+    def _default_engine(self, lines, batch_size, mesh=None):
         from ..models.m22000 import M22000Engine
 
         return M22000Engine(lines, nc=self.nc, batch_size=batch_size,
-                            mesh=self.mesh, pmk_store=self.pmk_store,
+                            mesh=self.mesh if mesh is None else mesh,
+                            pmk_store=self.pmk_store,
                             verify_with_oracle=self.verify_with_oracle)
+
+    def _factory_takes_mesh(self) -> bool:
+        """Whether the engine factory accepts a ``mesh`` kwarg.  Stream
+        waves REQUIRE it: each bundle must run on a 1-device mesh, and
+        a factory that silently ignores ``mesh`` would hand every
+        stream thread a full-mesh engine — concurrent collective
+        programs dispatched from different threads interleave their
+        per-device enqueues and deadlock the AllReduce rendezvous, so
+        such factories (the old two-arg test fakes) pin the executor
+        to the lockstep path instead."""
+        try:
+            import inspect
+
+            params = inspect.signature(self._engine_factory).parameters
+            return "mesh" in params or any(
+                p.kind is p.VAR_KEYWORD for p in params.values())
+        except (TypeError, ValueError):
+            return False
+
+    def _make_engine(self, lines, batch_size, mesh=None):
+        """Build a wave engine, passing ``mesh`` through only when the
+        factory's signature takes it (two-arg factories only ever see
+        lockstep waves — see ``_factory_takes_mesh``)."""
+        if mesh is not None and self._factory_takes_mesh():
+            return self._engine_factory(lines, batch_size, mesh=mesh)
+        return self._engine_factory(lines, batch_size)
 
     def _next_wave(self, exhausted):
         """Assemble the next wave: deferred holdovers first, then fresh
@@ -193,10 +224,10 @@ class MultiUnitExecutor:
                 break  # keep wave assembly cheap; collider leads next wave
         return wave
 
-    def _run_wave(self, wave, batch_size):
+    def _run_wave(self, wave, batch_size, mesh=None):
         """Crack one wave through a fresh engine's fused path."""
         lines = [ln for u in wave for ln in u.lines]
-        engine = self._engine_factory(lines, batch_size)
+        engine = self._make_engine(lines, batch_size, mesh)
         by_essid = {}
         for u in wave:
             u._done = {}
@@ -225,6 +256,103 @@ class MultiUnitExecutor:
                            max_units=self.fuse_max_units,
                            tracer=self.tracer, on_fused=on_fused)
 
+    # -- device-stream wave scheduling (parallel/streams.py) ---------------
+
+    def _streams_enabled(self) -> bool:
+        from ..parallel.streams import streams_default
+
+        if self.streams == "auto":
+            return streams_default()
+        return bool(self.streams)
+
+    def _stream_bundles(self, wave, batch_size, ndev):
+        """Partition one ESSID-disjoint wave into per-device bundles:
+        big units (a whole device batch or more of candidates) get a
+        chip to themselves; small units spread over free chips first,
+        then pack greedily (lightest small bundle, ``fuse_max_units``
+        cap).  Each bundle is itself a valid wave — ESSID disjointness
+        is inherited from the wave it was cut from."""
+        sized = sorted(wave, key=lambda u: -len(u._materialized or ()))
+        bundles = []   # [units], small bundles may grow
+
+        def small_open():
+            return [b for b in bundles
+                    if len(b) < self.fuse_max_units
+                    and len(b[0]._materialized or ()) < batch_size]
+
+        for u in sized:
+            size = len(u._materialized or ())
+            if size >= batch_size or len(bundles) < ndev:
+                bundles.append([u])
+                continue
+            opened = small_open()
+            if opened:
+                min(opened, key=lambda b: sum(
+                    len(x._materialized or ()) for x in b)).append(u)
+            else:
+                bundles.append([u])
+        return bundles
+
+    def _run_wave_streams(self, wave, batch_size):
+        """Scatter one wave onto independent device streams: each
+        bundle runs ``crack_fused`` on its own 1-device mesh engine, so
+        a big mask/dict unit and a clutch of small fused units crack
+        concurrently on different chips instead of padding the whole
+        lockstep mesh.  The per-unit demux is untouched — each unit
+        lives in exactly one bundle, so its ``on_batch`` state is
+        single-threaded.  Any bundle failure re-raises as RuntimeError
+        for ``run``'s existing retry/requeue containment."""
+        import jax
+
+        from ..parallel import default_mesh
+
+        devices = jax.local_devices()
+        bundles = self._stream_bundles(wave, batch_size, len(devices))
+        work = queue.Queue()
+        for b in bundles:
+            work.put(b)
+        errs = []
+
+        def drain(device):
+            mesh = default_mesh(devices=[device])
+            while not errs:
+                try:
+                    b = work.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    self._run_wave(b, batch_size, mesh=mesh)
+                except BaseException as e:  # contained by run()'s retry
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=drain, args=(d,), daemon=True,
+                             name=f"sched-stream-{i}")
+            for i, d in enumerate(devices[:len(bundles)])
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            err = errs[0]
+            if isinstance(err, RuntimeError):
+                raise err
+            raise RuntimeError(f"stream wave failed: {err!r}") from err
+
+    def _execute_wave(self, wave, batch_size):
+        """One wave, streams or lockstep: streams when enabled, more
+        than one unit to spread, and more than one local device —
+        otherwise the classic full-mesh fused path."""
+        if (self._streams_enabled() and len(wave) > 1
+                and self._factory_takes_mesh()):
+            import jax
+
+            if jax.local_device_count() > 1 and jax.process_count() == 1:
+                self._run_wave_streams(wave, batch_size)
+                return
+        self._run_wave(wave, batch_size)
+
     def run(self) -> list:
         """Drain every unit; returns the completed units in finish order.
 
@@ -241,7 +369,7 @@ class MultiUnitExecutor:
                     break
                 continue
             try:
-                self._run_wave(wave, self.batch_size)
+                self._execute_wave(wave, self.batch_size)
             except RuntimeError:
                 # Satellite recovery: one in-process retry at half batch
                 # (an XLA OOM on the fused width usually fits at W/2;
@@ -249,7 +377,7 @@ class MultiUnitExecutor:
                 if self._m_retries is not None:
                     self._m_retries.inc()
                 try:
-                    self._run_wave(wave, max(1, self.batch_size // 2))
+                    self._execute_wave(wave, max(1, self.batch_size // 2))
                 except RuntimeError:
                     requeued = False
                     for u in wave:

@@ -727,6 +727,91 @@ def test_dw109_real_fused_packers_are_clean():
 
 
 # ---------------------------------------------------------------------------
+# DW110: device-stream isolation
+# ---------------------------------------------------------------------------
+
+STREAMS_PATH = "dwpa_tpu/parallel/streams.py"
+
+
+def test_dw110_collective_in_stream_module():
+    """The seeded failure mode: a psum hits-gate copied from the
+    lockstep step into a stream — it would barrier every stream
+    against its siblings (or deadlock on uneven block counts)."""
+    src = """
+        import jax
+
+        def gate(found):
+            import jax.numpy as jnp
+            return jax.lax.psum(jnp.sum(found), "dp")
+    """
+    vs = lint(src, STREAMS_PATH)
+    assert codes(vs) == ["DW110"]
+    assert "collective" in vs[0].detail
+    # scoped to the stream modules: the lockstep step keeps its psum
+    assert lint(src, "dwpa_tpu/parallel/step.py") == []
+
+
+def test_dw110_blocking_fetch_in_dispatch_loop():
+    vs = lint("""
+        import jax
+
+        def run(blocks, step):
+            outs = []
+            for b in blocks:
+                outs.append(jax.device_get(step(b)))
+            while outs:
+                outs.pop().block_until_ready()
+    """, STREAMS_PATH)
+    assert codes(vs) == ["DW110", "DW110"]
+    assert all("stream loop" in v.detail for v in vs)
+
+
+def test_dw110_bare_device_put():
+    vs = lint("""
+        import jax
+
+        def stage(x):
+            return jax.device_put(x)
+    """, STREAMS_PATH)
+    assert codes(vs) == ["DW110"]
+    assert "explicit device/sharding" in vs[0].detail
+
+
+def test_dw110_compliant_stream_idioms_clean():
+    """The nearest compliant shapes: an explicitly-placed device_put
+    (positional and keyword), a fetch OUTSIDE any loop (the engine's
+    post-loop decode), and the engine's _collect call inside the loop
+    (the one allowed sync, a method of the engine — not a raw fetch)."""
+    vs = lint("""
+        import jax
+
+        def stage(x, dev, sharding):
+            a = jax.device_put(x, dev)
+            b = jax.device_put(x, device=dev)
+            c = jax.device_put(x, sharding=sharding)
+            return a, b, c
+
+        def run(eng, blocks):
+            founds = []
+            for b in blocks:
+                founds.extend(eng._collect(eng._dispatch(b)))
+            return jax.device_get(founds)
+    """, STREAMS_PATH)
+    assert vs == []
+
+
+def test_dw110_real_stream_module_is_clean():
+    """The shipped stream executor obeys its own discipline — proven
+    against the real tree, not a fixture."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    path = os.path.join(root, *STREAMS_PATH.split("/"))
+    assert [v for v in lint_file(path, root)
+            if v.code == "DW110"] == []
+
+
+# ---------------------------------------------------------------------------
 # recompilation sentinel
 # ---------------------------------------------------------------------------
 

@@ -14,6 +14,7 @@ import json
 import os
 import urllib.parse
 
+import jax
 import pytest
 
 from dwpa_tpu import testing as tfx
@@ -86,6 +87,12 @@ def _ingest(core, lines):
 def _client(server, tmp_path, registry=None, **cfg_kw):
     cfg_kw.setdefault("batch_size", 64)
     cfg_kw.setdefault("dictcount", 1)
+    # Lockstep by default: on the forced-8-device 1-core test host the
+    # stream path trades one fused 8-way execution for 8 serialized
+    # single-device ones, several times slower at these toy batch
+    # sizes.  test_metrics_after_one_work_unit opts back in ("auto")
+    # and carries the stream-path assertions for the whole file.
+    cfg_kw.setdefault("device_streams", "off")
     cfg = ClientConfig(base_url="http://loopback/",
                        workdir=str(tmp_path / "work"), **cfg_kw)
     api = LoopbackAPI(make_wsgi_app(server))
@@ -190,7 +197,12 @@ def test_metrics_after_one_work_unit(server, tmp_path):
     _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="mt1")])
     _add_dict(server, [b"filler-000001", PSK, b"filler-000002"])
     reg = MetricsRegistry()
-    client = _client(server, tmp_path, registry=reg)
+    client = _client(server, tmp_path, registry=reg, device_streams="auto")
+    # streams on under the 8-device single-process test mesh; a real
+    # single-chip host (DWPA_TEST_TPU=1) legitimately stays lockstep
+    use_streams = client._use_streams()
+    assert use_streams == (jax.local_device_count() > 1
+                           and jax.process_count() == 1)
 
     work = client.api.get_work(client.dictcount)
     res = client.process_work(work)
@@ -243,6 +255,20 @@ def test_metrics_after_one_work_unit(server, tmp_path):
     fed = sum(reg.series("dwpa_feed_candidates_total").values())
     assert fed >= res.candidates_tried
     assert reg.value("dwpa_span_seconds", span="feed:produce") >= 2
+
+    # device-stream telemetry (ISSUE-8): the 8-device single-process
+    # test mesh turns streams on by default, so every pass ran as
+    # per-device streams — blocks land in the per-device counter and
+    # the stream spans are traced alongside the pass spans
+    if use_streams:
+        stream_blocks = reg.series("dwpa_stream_blocks_total")
+        assert stream_blocks and sum(stream_blocks.values()) >= 2  # 2 passes
+        for labels, busy in reg.series("dwpa_stream_busy_fraction").items():
+            assert 0.0 <= busy <= 1.0, (labels, busy)
+        for labels, depth in reg.series("dwpa_stream_queue_depth").items():
+            assert depth >= 0, (labels, depth)
+        assert {"stream:dispatch", "stream:collect"} <= \
+            {r["name"] for r in recs}
 
 
 def test_pmkstore_metrics_and_warm_unit(server, tmp_path):

@@ -1,0 +1,118 @@
+"""Slow soak: two device streams over a long framed feed.
+
+Tier-1 runs the fast stream units (tests/test_streams.py); this soak —
+``-m slow``, ~30 s — drives two REAL single-device streams through
+many blocks with founds scattered across the stream, a mid-run crash
+on one stream, and asserts the long-haul contract: every block is
+demuxed exactly once in global order (consumed totals and on_batch
+sequence intact), the crashed stream's blocks finish on the survivor,
+found parity against the lockstep path holds over the whole run, and
+no stream thread outlives the executor.
+"""
+
+import threading
+
+import jax
+import pytest
+
+from dwpa_tpu import testing as synth
+from dwpa_tpu.feed import frame_blocks
+from dwpa_tpu.models.m22000 import M22000Engine
+from dwpa_tpu.parallel import StreamExecutor
+from dwpa_tpu.parallel.streams import device_label
+
+pytestmark = pytest.mark.slow
+
+BATCH = 32
+NBLOCKS = 40
+
+
+def _stream_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("stream-")]
+
+
+def _fixture():
+    """Six crackable nets on THREE shared ESSIDs plus one uncracked net.
+
+    The ESSID count is deliberate: the lockstep reference path runs one
+    collective-bearing ``shard_map`` step per ESSID group per block, and
+    the forced-host CPU backend deadlocks its AllReduce rendezvous when
+    too many such executions are in flight at once (7 groups stall even
+    at 10 blocks; 4 groups survive 40+).  The stream path has no such
+    limit — its per-device engines carry no collectives — which is
+    exactly the point of this executor, but the reference run must stay
+    inside the lockstep-safe envelope.
+    """
+    psks = [b"soak-stream-%02d" % i for i in range(6)]
+    lines = [synth.make_pmkid_line(p, b"SoakStream%c" % (65 + i % 3),
+                                   seed=f"ss{i}")
+             for i, p in enumerate(psks)]
+    # one net stays uncracked so neither path early-stops
+    lines.append(synth.make_pmkid_line(b"never-found-here", b"SoakStreamX",
+                                       seed="ssx"))
+    words = [b"soakjunk%05d" % i for i in range(BATCH * NBLOCKS)]
+    for i, p in enumerate(psks):     # scatter founds across the stream
+        words[7 + i * (len(words) // len(psks))] = p
+    return lines, words, psks
+
+
+def test_two_stream_soak_parity_with_crash_recovery():
+    lines, words, psks = _fixture()
+    devices = jax.devices()[:2]
+
+    lock_eng = M22000Engine(lines, batch_size=BATCH)
+    lock_log = []
+    lock_founds = lock_eng.crack_blocks(
+        frame_blocks(iter(words), lock_eng.batch_size),
+        on_batch=lambda c, f: lock_log.append((c, sorted(x.psk for x in f))))
+
+    st_eng = M22000Engine(lines, batch_size=BATCH)
+    sub = {}
+
+    class _CrashOnce:
+        """Engine proxy that kills stream 0 once, mid-run."""
+
+        armed = True
+
+        def __init__(self, eng):
+            self._eng = eng
+            self.dispatched = 0
+
+        def __getattr__(self, name):
+            return getattr(self._eng, name)
+
+        def _dispatch(self, prep):
+            self.dispatched += 1
+            if type(self).armed and self.dispatched == NBLOCKS // 4:
+                type(self).armed = False
+                raise RuntimeError("injected mid-soak stream crash")
+            return self._eng._dispatch(prep)
+
+    def factory(device):
+        from dwpa_tpu.parallel import default_mesh
+
+        eng = M22000Engine([n.line for n in st_eng.nets], nc=st_eng.nc,
+                           batch_size=st_eng.batch_size,
+                           mesh=default_mesh(devices=[device]))
+        sub[device_label(device)] = eng
+        if len(sub) == 1:            # first stream built gets the crash
+            return _CrashOnce(eng)
+        return eng
+
+    ex = StreamExecutor(factory, devices)
+    st_log = []
+    st_founds = ex.run(
+        frame_blocks(iter(words), st_eng.batch_size),
+        on_batch=lambda c, f: st_log.append((c, sorted(x.psk for x in f))))
+
+    keys = lambda fs: sorted((f.line.essid, f.psk, f.pmk) for f in fs)
+    assert keys(st_founds) == keys(lock_founds)
+    assert {f.psk for f in st_founds} == set(psks)
+    assert st_log == lock_log
+    assert sum(c for c, _ in st_log) == len(words)
+    assert len(ex.block_streams) == NBLOCKS
+    # the crash really happened and the survivor carried extra blocks
+    assert not _CrashOnce.armed
+    assert ex.block_streams.count(1) > ex.block_streams.count(0)
+    assert _stream_threads() == []
