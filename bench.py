@@ -393,6 +393,112 @@ def bench_pmkstore(batch: int, batches: int = 4, overlap: float = 0.875) -> dict
             "mixed_compiles": mixed_comp.count, "recompiles_warm": comp.count}
 
 
+def bench_dict_cache(batch: int, feed_words: int = 200_000,
+                     batches: int = 2) -> dict:
+    """bench:dict_cache — the packed-dict-cache acceptance measurement.
+
+    Feed-only legs: one ~200k-word gz dict drained through
+    ``DictFeedSource`` + ``CandidateFeed`` cold (gunzip + native pack +
+    the cache write riding along) and then warm (mmap'd packed chunks,
+    zero gunzip, zero per-word packing; the prep materialization memcpy
+    IS counted — it is the warm path's real per-block cost).  The
+    headline ``warm_speedup`` is warm/cold words/s: the host-side
+    feed-rate multiplier an 8-chip mesh's repeat passes see.
+
+    E2E legs: a planted-PSK dict cracked cold then warm through the
+    engine's pre-packed bypass (``host_packer(pre=...)``) — the found
+    list and per-batch consumed counts must be IDENTICAL, a mid-stream
+    resume skip must account identically, and the warm pass must add
+    zero XLA compiles (``recompiles_warm``).
+    """
+    import gzip
+    import tempfile
+
+    from dwpa_tpu.feed import CandidateFeed, DictCache, DictFeedSource
+    from dwpa_tpu.gen.dicts import md5_file
+    from dwpa_tpu.obs import MetricsRegistry
+
+    def write_dict(td, ws, name):
+        path = os.path.join(td, name + ".gz")
+        with open(path, "wb") as f:
+            f.write(gzip.compress(b"\n".join(ws) + b"\n"))
+        return path, md5_file(path)
+
+    def drain(units, cache, prepack=None, skip=0, engine=None,
+              on_batch=None):
+        src = DictFeedSource(units, batch_size=batch, cache=cache,
+                             skip=skip, name="bench_dcache")
+        feed = CandidateFeed(None, batch_size=batch, frames=src,
+                             producers=2, prepack=prepack,
+                             registry=MetricsRegistry(), name="bench_dcache")
+        try:
+            if engine is not None:
+                return engine.crack_blocks(feed, on_batch=on_batch)
+            n = 0
+            for blk in feed:
+                n += blk.count
+            return n
+        finally:
+            feed.close()
+
+    out = {"label": "dict_cache", "batch": batch, "feed_words": feed_words}
+    with tempfile.TemporaryDirectory() as td:
+        ws = [b"dcachebench-%09d" % i for i in range(feed_words)]
+        fpath, fh = write_dict(td, ws, "feedleg")
+        cache = DictCache(os.path.join(td, "dc"))
+        # feed-only spans launch no device work — nothing to sync
+        with TRACER.span("bench:dict_cache_cold") as sp:
+            n = drain([(fpath, fh)], cache)
+        out["cold_words_per_s"] = n / sp.seconds
+        with TRACER.span("bench:dict_cache_warm") as sp:
+            n = drain([(fpath, fh)], cache)
+        out["warm_words_per_s"] = n / sp.seconds
+        out["warm_speedup"] = (out["warm_words_per_s"]
+                               / out["cold_words_per_s"])
+        out["cache_bytes"] = cache._bytes_used()
+
+        # -- e2e: the warm feed composing with the engine's pre-packed
+        # bypass; plain crack shapes warm OUTSIDE the timed region
+        psk = b"benchpass1"
+        n2 = batches * batch
+        ws2 = [b"dcache-e2e-%09d" % i for i in range(n2 - 1)] + [psk]
+        epath, eh = write_dict(td, ws2, "e2eleg")
+        line = T.make_pmkid_line(psk, b"bench-dcache")
+        M22000Engine([line], batch_size=batch).crack_batch(
+            [b"dcachewarm0-%07d" % i for i in range(batch)])
+        ecache = DictCache(os.path.join(td, "dc2"))
+
+        def crack(cache_, skip=0):
+            consumed = []
+            eng = M22000Engine([line], batch_size=batch)
+            founds = drain([(epath, eh)], cache_,
+                           prepack=eng.host_packer(), skip=skip,
+                           engine=eng,
+                           on_batch=lambda c, f: consumed.append(c))
+            return [f.psk for f in founds], consumed
+
+        with TRACER.span("bench:dict_cache_e2e_cold") as sp:
+            cold_f, cold_c = crack(ecache)    # populates dc2
+        e2e_cold = sp.seconds
+        with watch_compiles() as comp:
+            with TRACER.span("bench:dict_cache_e2e_warm") as sp:
+                warm_f, warm_c = crack(ecache)
+        e2e_warm = sp.seconds
+        assert warm_f == cold_f == [psk], "cold/warm found-list parity"
+        assert warm_c == cold_c, "cold/warm consumed parity"
+        # resume parity: a mid-stream skip accounts identically whether
+        # it replays the gzip prefix or seeks the block index
+        skip = n2 // 3
+        rf_cold, rc_cold = crack(None, skip=skip)
+        rf_warm, rc_warm = crack(ecache, skip=skip)
+        assert rf_cold == rf_warm == [psk] and rc_cold == rc_warm, \
+            "cold/warm resume parity"
+        out.update(e2e_words=n2, e2e_cold_pmk_per_s=n2 / e2e_cold,
+                   e2e_warm_pmk_per_s=n2 / e2e_warm,
+                   recompiles_warm=comp.count)
+    return out
+
+
 def bench_small_units(nunits: int = 8, words_per_unit: int = 1000,
                       batch: int = None) -> dict:
     """bench:small_units — the unit-fusion acceptance measurement.
@@ -710,6 +816,7 @@ def main():
     feed = bench_host_feed()
     feed_ov = bench_feed_overlap(batch)
     pmkstore = bench_pmkstore(batch)
+    dcache = bench_dict_cache(batch)
     small_units = bench_small_units()
     streams = bench_device_streams()
     overhead = bench_unit_overhead(pmkid)
@@ -735,6 +842,7 @@ def main():
                     "host_feed": _round(feed),
                     "feed_overlap": _round(feed_ov),
                     "pmkstore": _round(pmkstore),
+                    "dict_cache": _round(dcache),
                     "small_units": _round(small_units),
                     "device_streams": _round(streams),
                     "unit_overhead": _round(overhead),

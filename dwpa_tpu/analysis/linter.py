@@ -92,6 +92,18 @@ Rule codes (stable — referenced by baseline.json and the docs):
   ``.stop()`` pair) in the instrumented files (``SPAN_FILES``) that
   launches device work without forcing completion before the clock
   stops — DW105's device-sync rule, ported to the span API.
+- **DW111 dictcache-discipline** — the packed-dictionary-cache contract
+  (``dwpa_tpu/feed/dictcache``), two shapes: (a) a dict-cache I/O call
+  (``reader``/``writer``/``add_many``/``commit``/``abort``/``chunks``/
+  ``evict`` on a cache-named receiver) inside a function under a JAX
+  trace — cache reads are host mmap/file work and a traced region that
+  touches them either fails on a tracer or bakes one chunk's bytes into
+  the compiled program; (b) the same call anywhere outside the feed
+  subsystem (``dwpa_tpu/feed/``) — dict-cache reads/writes belong to
+  feed producer threads (``DictFeedSource`` drives them under the
+  feed's source lock), the same seam discipline as DW107/DW108; client
+  or engine code touching the cache directly would put file I/O on the
+  consumer's dispatch path.
 
 The linter is repo-native, not general-purpose: rules are scoped to the
 paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
@@ -129,6 +141,15 @@ PMKSTORE_WRITEBACK_FILES = ("dwpa_tpu/pmkstore/", "dwpa_tpu/models/m22000.py")
 
 #: directories whose producer-thread discipline DW107(b) polices
 FEED_DIRS = ("dwpa_tpu/feed",)
+#: dict-cache I/O methods DW111 polices, and the receiver names that
+#: mark the call as cache I/O (so ``csv.writer(...)``/``conn.commit()``
+#: stay clean while ``dict_cache.reader`` / ``self._dcache.evict`` flag)
+DICTCACHE_IO_METHODS = {"reader", "writer", "add_many", "commit",
+                        "abort", "chunks", "evict"}
+_DICTCACHE_RECV = re.compile(r"(?i)(dict_?cache$|^_?cache$|^_?dcache$)")
+#: the only files allowed to perform dict-cache I/O (DW111(b)) — the
+#: feed subsystem, whose producer threads own the cache seam
+DICTCACHE_FEED_FILES = ("dwpa_tpu/feed/",)
 #: jax calls a feed producer thread MAY make (H2D staging only)
 FEED_PRODUCER_ALLOWED = {"device_put", "shard_candidates"}
 #: blocking-sync methods DW107(a) bans inside traced regions, and the
@@ -478,6 +499,17 @@ def _check_traced_function(fn, how, static_names, static_nums, path,
                     "work; a trace either fails on them or bakes one "
                     "lookup's result into the compiled program",
                     _line(src_lines, node)))
+            elif (name in DICTCACHE_IO_METHODS
+                    and isinstance(node.func, ast.Attribute)
+                    and _DICTCACHE_RECV.search(_recv_name(node.func))):
+                out.append(Violation(
+                    "DW111", path, node.lineno,
+                    f"dictcache I/O {name}() inside traced function "
+                    f"({how}) — packed-dict cache reads/writes are "
+                    "producer-thread host work (mmap/file I/O); a trace "
+                    "either fails on them or bakes one chunk's bytes "
+                    "into the compiled program",
+                    _line(src_lines, node)))
 
 
 def _is_at_update(f: ast.Attribute) -> bool:
@@ -549,6 +581,30 @@ def _check_pmkstore_writeback(tree, path, src_lines, out):
                 f"allowed set ({', '.join(PMKSTORE_WRITEBACK_FILES)}) — "
                 "newly derived PMKs are written back only after the "
                 "engine's device fetch", _line(src_lines, node)))
+
+
+# ---------------------------------------------------------------------------
+# DW111(b): dict-cache I/O outside the feed subsystem
+# ---------------------------------------------------------------------------
+
+
+def _check_dictcache_io(tree, path, src_lines, out):
+    """Outside ``DICTCACHE_FEED_FILES``: any dict-cache I/O call is on
+    the wrong seam — the packed-dict cache is read and written by feed
+    producer threads (``DictFeedSource``); client/engine code holds a
+    ``DictCache`` handle only to pass it INTO the feed."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and _call_name(node) in DICTCACHE_IO_METHODS
+                and isinstance(node.func, ast.Attribute)
+                and _DICTCACHE_RECV.search(_recv_name(node.func))):
+            out.append(Violation(
+                "DW111", path, node.lineno,
+                f"dictcache I/O .{_call_name(node)}() on "
+                f"'{_recv_name(node.func)}' outside the feed subsystem "
+                f"({', '.join(DICTCACHE_FEED_FILES)}) — dict-cache "
+                "reads/writes belong to feed producer threads",
+                _line(src_lines, node)))
 
 
 # ---------------------------------------------------------------------------
@@ -961,6 +1017,8 @@ def lint_source(src: str, path: str) -> list:
         _check_feed_producers(tree, path, src_lines, out)
     if not path.startswith(PMKSTORE_WRITEBACK_FILES):
         _check_pmkstore_writeback(tree, path, src_lines, out)
+    if not path.startswith(DICTCACHE_FEED_FILES):
+        _check_dictcache_io(tree, path, src_lines, out)
     if path in FUSED_PAD_FILES:
         _check_fused_pad_widths(tree, path, src_lines, out)
     if path in STREAM_FILES:

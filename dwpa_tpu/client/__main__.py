@@ -51,6 +51,15 @@ def build_parser():
                    default=256 * 1024 * 1024,
                    help="PMK store on-disk cap; oldest segments are "
                         "evicted beyond it (default 256 MiB)")
+    p.add_argument("--dict-cache-dir",
+                   help="packed-dictionary cache directory: first full "
+                        "stream of a dict persists its packed device "
+                        "blocks; later units mmap them with O(1) seek "
+                        "(README 'Dict cache')")
+    p.add_argument("--dict-cache-max-bytes", type=int,
+                   default=4 * 1024 * 1024 * 1024,
+                   help="dict cache on-disk cap; least-recently-used "
+                        "entries are evicted beyond it (default 4 GiB)")
     p.add_argument("--unit-queue", type=int, default=4,
                    help="work units prefetched ahead of the device by "
                         "the fused multi-unit executor (README 'Unit "
@@ -105,6 +114,8 @@ def main(argv=None):
         feed_workers=args.feed_workers,
         pmk_cache_dir=args.pmk_cache_dir,
         pmk_cache_max_bytes=args.pmk_cache_max_bytes,
+        dict_cache_dir=args.dict_cache_dir,
+        dict_cache_max_bytes=args.dict_cache_max_bytes,
         unit_queue=args.unit_queue,
         fuse_max_units=args.fuse_max_units,
         device_streams=args.device_streams,

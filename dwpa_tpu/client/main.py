@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 import jax
 
 from ..analysis import watch_compiles
-from ..feed import CandidateFeed
+from ..feed import CandidateFeed, DictFeedSource
 from ..feed.framing import frame_blocks
 from ..gen import DictStream, psk_candidates
 from ..models import hashline as hl
@@ -159,6 +159,13 @@ class ClientConfig:
     pmk_cache_max_bytes: int = 256 * 1024 * 1024
                                     # --pmk-cache-max-bytes: store size cap
                                     # (oldest segments evicted beyond it)
+    dict_cache_dir: str = None      # --dict-cache-dir: persistent packed
+                                    # dictionary cache keyed by dhash
+                                    # (dwpa_tpu/feed/dictcache)
+    dict_cache_max_bytes: int = 4 * 1024 * 1024 * 1024
+                                    # --dict-cache-max-bytes: cache size cap
+                                    # (least-recently-used dicts evicted
+                                    # beyond it)
     unit_queue: int = 4             # --unit-queue: work units prefetched
                                     # ahead of the device by the fused
                                     # executor (dwpa_tpu/sched)
@@ -285,6 +292,22 @@ class TpuCrackClient:
                     config.pmk_cache_dir,
                     max_bytes=config.pmk_cache_max_bytes,
                     registry=self.registry)
+        # Persistent packed-dictionary cache (optional): pass-2 server
+        # dicts — ~100%-recurring inputs keyed by dhash — are served as
+        # mmap'd pre-packed blocks on every unit after the first (zero
+        # gunzip/packing, O(1) resume and shard seeks).  Safe on any
+        # mesh: per-dict framing derives identical block geometry from
+        # the dict word counts whatever each host's cache state, and a
+        # changed server dict gets a new dhash (old entries age out of
+        # the LRU cap).
+        self.dict_cache = None
+        if config.dict_cache_dir:
+            from ..feed.dictcache import DictCache
+
+            self.dict_cache = DictCache(
+                config.dict_cache_dir,
+                max_bytes=config.dict_cache_max_bytes,
+                registry=self.registry)
         self.resume_path = os.path.join(config.workdir, "resume.json")
         self._digest_cache = {}  # (path, size, mtime_ns) -> md5 hex
         self.potfile = config.potfile or os.path.join(config.workdir, "potfile")
@@ -522,6 +545,17 @@ class TpuCrackClient:
                 self.api.download(d["dpath"], dest, expected_md5=d["dhash"])
             paths.append(dest)
         return paths
+
+    @staticmethod
+    def _dict_key(path: str) -> str:
+        """Dict-cache key for a pass-2 path: server dicts land as
+        ``<dictdir>/<dhash>.gz`` (``_fetch_dicts``), so the basename IS
+        the md5 the server published — and a regenerated dict gets a
+        new dhash, which is the cache's invalidation rule.  Paths not
+        named by an md5 (e.g. ``additional_dict``) return None and
+        stream cold, uncached."""
+        stem = os.path.splitext(os.path.basename(path))[0]
+        return stem if re.fullmatch(r"[0-9a-f]{32}", stem) else None
 
     def _cracked_candidates(self, work: dict, rules):
         """Pass-1 stream of the server's cracked + rkg dictionaries,
@@ -889,10 +923,27 @@ class TpuCrackClient:
                     # global count so the checkpoint keeps counting
                     # stream positions.  Single-process degenerates to
                     # nproc=1 framing — one code path for both.
-                    feed2 = CandidateFeed(
-                        words, batch_size=self.cfg.batch_size, skip=skip2,
-                        prepack=engine.host_packer(), name="pass2",
-                        **cfg_feed)
+                    if self.dict_cache is not None:
+                        # Packed-dict cache path: per-dict framing
+                        # (identical geometry on every host whatever
+                        # its cache state), warm dicts served as
+                        # pre-packed mmap blocks, cold dicts streamed
+                        # once and written back.  The source owns the
+                        # resume skip — warm skips are index seeks.
+                        src = DictFeedSource(
+                            [(p, self._dict_key(p)) for p in paths],
+                            batch_size=self.cfg.batch_size,
+                            cache=self.dict_cache, skip=skip2,
+                            name="pass2", log=self.log)
+                        feed2 = CandidateFeed(
+                            None, batch_size=self.cfg.batch_size,
+                            frames=src, prepack=engine.host_packer(),
+                            name="pass2", **cfg_feed)
+                    else:
+                        feed2 = CandidateFeed(
+                            words, batch_size=self.cfg.batch_size,
+                            skip=skip2, prepack=engine.host_packer(),
+                            name="pass2", **cfg_feed)
                     try:
                         self._crack_blocks(engine, feed2, on_batch=on_batch)
                     finally:

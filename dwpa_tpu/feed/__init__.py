@@ -6,23 +6,34 @@ calls for:
 
 - :mod:`.framing` — deterministic ``(global_offset, count)`` block
   framing (single-host and multi-host shard slicing; the resume-gate
-  and SPMD-lockstep contracts live here);
+  and SPMD-lockstep contracts live here), plus ``frame_packed`` — the
+  index-backed twin that frames mmap'd dict-cache chunks into the
+  same geometry with lazy ``PackedSlices`` preps;
 - :mod:`.pipeline` — ``CandidateFeed``: bounded block queue filled by
   producer threads running the host stages (dict streaming, rule
   expansion, ``$HEX`` decode + native packing), with backpressure,
-  fault-with-offset delivery, and ``dwpa_feed_*`` telemetry;
+  fault-with-offset delivery, and ``dwpa_feed_*`` telemetry; and
+  ``DictFeedSource`` — the warm/cold dict adapter for
+  ``CandidateFeed(frames=...)``;
+- :mod:`.dictcache` — ``DictCache``: the persistent packed-dictionary
+  cache (CRC-framed chunks keyed by dhash, O(1) ``(offset, count)``
+  seek, byte-capped LRU eviction) the warm path serves from;
 - :mod:`.staging` — ``DeviceStager``: double-buffered ``shard_candidates``
   H2D, enqueueing block N+1's upload while block N's steps execute.
 
 Consumed by ``M22000Engine.crack_blocks`` and wired through the client
-(pass 1, both pass-2 paths, prewarm) and ``bench:feed_overlap``.
+(pass 1, both pass-2 paths, prewarm) and ``bench:feed_overlap`` /
+``bench:dict_cache``.
 """
 
-from .framing import Block, frame_blocks, skip_stream
-from .pipeline import CandidateFeed, FeedError
+from .dictcache import DictCache
+from .framing import Block, PackedSlices, frame_blocks, frame_packed, \
+    skip_stream
+from .pipeline import CandidateFeed, DictFeedSource, FeedError
 from .staging import DeviceStager
 
 __all__ = [
-    "Block", "frame_blocks", "skip_stream",
-    "CandidateFeed", "FeedError", "DeviceStager",
+    "Block", "PackedSlices", "frame_blocks", "frame_packed", "skip_stream",
+    "CandidateFeed", "DictFeedSource", "FeedError", "DeviceStager",
+    "DictCache",
 ]

@@ -37,6 +37,8 @@ import itertools
 from collections import deque
 from dataclasses import dataclass
 
+import numpy as np
+
 
 @dataclass
 class Block:
@@ -113,6 +115,84 @@ def frame_blocks(words, batch_size: int, nproc: int = 1, pid: int = 0,
         mine += [pad_word] * (blk - nreal)
         yield Block(offset=offset, count=c, words=mine, padded=(nreal == 0))
         offset += c
+        if c < gsize:
+            return
+
+
+class PackedSlices:
+    """Lazy prep for a warm dict-cache block: zero-copy ``(lens, rows)``
+    column windows into mmap'd cache chunks, materialized into the
+    ``(rows uint32[cap, 16], lens uint8[nvalid], nvalid)`` staged form
+    on a feed producer thread (``CandidateFeed._pack``) — the memcpy
+    out of the page cache happens off the consumer's critical path,
+    and N producers can materialize disjoint block ranges in parallel
+    (the mmap is read-only and shared).
+
+    ``materialize()`` reproduces EXACTLY what ``pack_candidates_fast``
+    returns for the block's word slice on the cold path: accepted rows
+    contiguous from 0 in stream order, zero rows beyond ``nvalid``,
+    ``cap == batch_size`` (a host slice is never wider than one batch).
+    """
+
+    __slots__ = ("parts", "cap")
+
+    def __init__(self, parts, cap: int):
+        self.parts = parts   # [(lens uint8[k] view, rows u32[nv, 16] view)]
+        self.cap = cap
+
+    def materialize(self):
+        packed = np.zeros((self.cap, 16), np.uint32)
+        lens, r = [], 0
+        for lens_all, rows in self.parts:
+            nv = rows.shape[0]
+            if nv:
+                packed[r:r + nv] = rows
+                lens.append(lens_all[lens_all > 0])
+                r += nv
+        lens = (np.concatenate(lens) if lens else np.zeros(0, np.uint8))
+        return packed, lens, r
+
+
+def frame_packed(chunks, total: int, batch_size: int, nproc: int = 1,
+                 pid: int = 0, base_offset: int = 0, start: int = 0):
+    """Frame a warm packed-dict word range into ``Block``s — the
+    index-backed twin of ``frame_blocks``: identical ``(offset, count,
+    padded)`` geometry for the same word stream and ``(batch_size,
+    nproc, pid)``, but driven by the cache's chunk index instead of the
+    decoded words (``Block.words`` stays empty; ``Block.prep`` carries
+    a lazy ``PackedSlices``).
+
+    ``chunks`` yields ``(chunk_word_offset, lens, rows)`` views
+    (``CachedDict.chunks(start)``); ``total`` is the dict's word count;
+    ``start`` is the first word index to serve (a resume/shard seek —
+    an index lookup, not a prefix replay); ``base_offset`` is the
+    GLOBAL stream offset of word ``start``.
+    """
+    it = iter(chunks)
+    cur = None               # (chunk base, lens view, valid-cumsum, rows)
+    gsize = batch_size * nproc
+    pos = start
+    while pos < total:
+        c = min(gsize, total - pos)
+        blk = _blk(c, batch_size, nproc)
+        lo = pos + min(pid * blk, c)
+        hi = pos + min(pid * blk + blk, c)
+        parts = []
+        a = lo
+        while a < hi:
+            while cur is None or cur[0] + len(cur[1]) <= a:
+                cbase, lens_all, rows = next(it)
+                cur = (cbase, lens_all, np.cumsum(lens_all != 0), rows)
+            cbase, lens_all, vcum, rows = cur
+            b = min(hi, cbase + len(lens_all))
+            i, j = a - cbase, b - cbase
+            vs = int(vcum[i - 1]) if i else 0
+            ve = int(vcum[j - 1]) if j else 0
+            parts.append((lens_all[i:j], rows[vs:ve]))
+            a = b
+        yield Block(offset=base_offset + (pos - start), count=c, words=[],
+                    prep=PackedSlices(parts, batch_size), padded=(hi == lo))
+        pos += c
         if c < gsize:
             return
 

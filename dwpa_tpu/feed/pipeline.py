@@ -49,8 +49,8 @@ import time
 
 import jax
 
-from ..obs import SpanTracer, default_registry
-from .framing import frame_blocks, skip_stream
+from ..obs import SpanTracer, default_registry, get_logger
+from .framing import frame_blocks, frame_packed, skip_stream
 
 
 class FeedError(RuntimeError):
@@ -80,12 +80,21 @@ class CandidateFeed:
     ``M22000Engine.host_packer``) run on the producer thread — with a
     PMK store attached it also performs the per-ESSID cache hit/miss
     split (``pmkstore.stage.split_block``), still pure host work.
+
+    ``frames``: a pre-framed ``Block`` iterator (``DictFeedSource``)
+    consumed INSTEAD of word framing — the source owns geometry and
+    skip (pass ``skip=0``; warm cache skips are index lookups there,
+    see ``feed.dictcache``).  Blocks arriving with a lazy prep
+    (``framing.PackedSlices``) are materialized in ``_pack`` on the
+    producer threads, then handed to a ``pre=``-aware ``prepack``
+    (``host_packer``'s bypass) so the PMK-store hit/miss split still
+    composes with cache-served blocks.
     """
 
     def __init__(self, source, batch_size: int, *, depth: int = 2,
                  producers: int = 1, skip: int = 0, nproc: int = None,
                  pid: int = None, pad_word: bytes = b"", prepack=None,
-                 registry=None, name: str = "feed"):
+                 registry=None, name: str = "feed", frames=None):
         self.batch_size = int(batch_size)
         self.depth = max(1, int(depth))
         self.name = name
@@ -95,11 +104,19 @@ class CandidateFeed:
         self._skip = max(0, int(skip))
         self._skipped = 0
         self._skip_done = threading.Event()
-        self._src = iter(source)
         self._frontier = self._skip  # global offset of the framing edge
-        self._frames = frame_blocks(self._src, self.batch_size, nproc=nproc,
-                                    pid=pid, pad_word=pad_word,
-                                    base_offset=self._skip)
+        if frames is not None:
+            if self._skip:
+                raise ValueError(
+                    "frames= sources own their skip (pass skip=0)")
+            self._src = iter(())
+            self._frames = iter(frames)
+        else:
+            self._src = iter(source)
+            self._frames = frame_blocks(self._src, self.batch_size,
+                                        nproc=nproc, pid=pid,
+                                        pad_word=pad_word,
+                                        base_offset=self._skip)
         # _src_lock serializes source access (skip + framing); _cv guards
         # the reorder buffer, sequence counters and stop/fault state.
         # Producers take _src_lock then _cv; the consumer only ever takes
@@ -180,6 +197,18 @@ class CandidateFeed:
         accounting + native prepack.  NO jax device APIs here beyond
         what ``prepack`` itself stages (lint rule DW107)."""
         with self.tracer.span("feed:produce"):
+            pre = blk.prep
+            if pre is not None and hasattr(pre, "materialize"):
+                # warm dict-cache block: copy the mmap-backed column
+                # slices into the staged (rows, lens, nvalid) form here,
+                # in parallel across producers; a pre-aware prepack
+                # (host_packer's bypass) then composes the PMK-store
+                # split without re-packing a single word
+                blk.prep = pre = pre.materialize()
+                self._m_bytes.inc(int(pre[1].sum()))
+                if getattr(self.prepack, "supports_pre", False):
+                    blk.prep = self.prepack(blk.words, pre=pre)
+                return
             self._m_bytes.inc(blk.nbytes)
             if self.prepack is not None:
                 blk.prep = self.prepack(blk.words)
@@ -301,3 +330,142 @@ class CandidateFeed:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+#: a cold skip larger than this replays the gzip prefix long enough to
+#: matter — logged once per unit so the operator knows the O(skip)
+#: hazard fired (the warm path never does: cache skips are index seeks)
+SKIP_REPLAY_WARN = 1_000_000
+
+#: words buffered per cache-writer hand-off on the cold tee
+_TEE_WORDS = 4096
+
+
+class DictFeedSource:
+    """Framed block source over a unit's dict files — warm where the
+    packed cache has them, cold (with cache write-back) where not.
+
+    The warm-source adapter of ``feed.dictcache``: feed it to
+    ``CandidateFeed(frames=...)``.  Each dict is framed SEPARATELY
+    (offsets stay global and contiguous across dicts), so every host
+    derives the same ``(offset, count)`` block geometry from the dict
+    word counts alone — a mesh where one host is cache-warm and
+    another cold still frames identically, which is what keeps the
+    SPMD-lockstep and resume contracts cache-state-independent.
+
+    ``units``: ``[(path, dhash | None)]`` in stream order (a None
+    dhash is never cached).  ``skip`` is the GLOBAL resume
+    fast-forward: warm dicts satisfy it with an index seek (O(1));
+    cold dicts replay the prefix (today's semantics) and log once per
+    unit past ``SKIP_REPLAY_WARN`` words.  ``skipped`` reports the
+    words actually consumed by the skip, exactly like
+    ``CandidateFeed.skipped``.
+
+    Iteration is driven from the feed's producer side (under its
+    source lock), so cache reads/writes stay on producer threads —
+    lint rule DW111's discipline, same shape as DW107/DW108.
+    """
+
+    def __init__(self, units, batch_size: int, *, cache=None,
+                 nproc: int = None, pid: int = None, pad_word: bytes = b"",
+                 skip: int = 0, name: str = "feed", log=None):
+        self.units = list(units)
+        self.batch_size = int(batch_size)
+        self.cache = cache
+        self.nproc = jax.process_count() if nproc is None else nproc
+        self.pid = jax.process_index() if pid is None else pid
+        self.pad_word = pad_word
+        self.name = name
+        self.skipped = 0
+        self._skip = max(0, int(skip))
+        self._log = log or get_logger("feed").info
+
+    def _tee(self, stream, wr):
+        """Pass words through to the framer while batching them into
+        the cache writer; commits on full-stream exhaustion (a partial
+        consume is aborted by the iterator's finally)."""
+        buf = []
+        for w in stream:
+            buf.append(w)
+            if len(buf) >= _TEE_WORDS:
+                wr.add_many(buf)
+                buf = []
+            yield w
+        wr.add_many(buf)
+        wr.commit()
+
+    def __iter__(self):
+        cache = self.cache
+        offset = 0            # global stream position (skipped + served)
+        remaining = self._skip
+        warned = False
+        for path, dhash in self.units:
+            rd = cache.reader(dhash) if cache is not None else None
+            if rd is not None:
+                # -- warm: mmap'd packed blocks, zero gunzip ------------
+                total = rd.total_words
+                if remaining >= total:
+                    # whole dict inside the resume window: pure index
+                    # math, nothing decompressed, nothing replayed
+                    remaining -= total
+                    self.skipped += total
+                    offset += total
+                    continue
+                start = remaining
+                self.skipped += start
+                remaining = 0
+                t0 = time.perf_counter()
+                served = 0
+                for blk in frame_packed(rd.chunks(start), total,
+                                        self.batch_size, nproc=self.nproc,
+                                        pid=self.pid,
+                                        base_offset=offset + start,
+                                        start=start):
+                    cache.m_hit_blocks.inc()
+                    served += blk.count
+                    yield blk
+                el = time.perf_counter() - t0
+                if served and el > 0:
+                    cache.m_words_warm.set(served / el)
+                offset += total
+                continue
+            # -- cold: gunzip stream; write the cache alongside --------
+            from ..gen.dicts import DictStream
+
+            stream = iter(DictStream(path))
+            if remaining:
+                if remaining > SKIP_REPLAY_WARN and not warned:
+                    warned = True
+                    self._log(
+                        f"feed {self.name}: cold dict skip replays "
+                        f"{remaining} words (O(skip) gzip prefix; a warm "
+                        f"dict cache would seek the block index instead)")
+                k = skip_stream(stream, remaining)
+                self.skipped += k
+                offset += k
+                remaining -= k
+                if remaining:
+                    continue      # dict exhausted inside the skip window
+            # cache only FULL streams from word 0 — the framer consumes
+            # every source word even when slicing for one host, so the
+            # tee sees the complete dict on any mesh
+            wr = cache.writer(dhash) if cache is not None else None
+            src = stream if wr is None else self._tee(stream, wr)
+            t0 = time.perf_counter()
+            served = 0
+            try:
+                for blk in frame_blocks(src, self.batch_size,
+                                        nproc=self.nproc, pid=self.pid,
+                                        pad_word=self.pad_word,
+                                        base_offset=offset):
+                    if cache is not None:
+                        cache.m_miss_blocks.inc()
+                    served += blk.count
+                    offset = blk.offset + blk.count
+                    yield blk
+            finally:
+                if wr is not None:
+                    wr.abort()    # no-op after the tee's commit
+            el = time.perf_counter() - t0
+            if cache is not None and served and el > 0:
+                cache.m_words_cold.set(served / el)

@@ -64,18 +64,47 @@ class DictStream:
             return gzip.open(f), (f if owns else None)
         return f, (f if owns else None)
 
+    #: decompressed bytes per read — one gunzip call amortized over
+    #: thousands of lines instead of the line iterator's per-line trips
+    #: through the gzip object (bench: host_feed.dictstream_words_per_s)
+    CHUNK = 1 << 18
+
     def __iter__(self):
         n = 0
         f, owned_raw = self._open()
         try:
-            for i, line in enumerate(f):
-                if i < self.skip:
-                    continue
-                if self.limit is not None and n >= self.limit:
-                    return
-                word = line.rstrip(b"\r\n")
+            # Chunked read + manual b"\n" split with a carry for the
+            # partial tail.  Semantics are bit-identical to iterating
+            # the binary fileobj line-by-line: lines split on b"\n"
+            # ONLY (a lone \r stays inside its line), ``skip`` counts
+            # line indices INCLUDING blank lines, ``limit`` counts
+            # yielded words, trailing \r/\n runs are stripped, and a
+            # final line without a newline still counts.
+            skip, limit = self.skip, self.limit
+            i = 0
+            carry = b""
+            while True:
+                chunk = f.read(self.CHUNK)
+                if not chunk:
+                    break
+                if carry:
+                    chunk = carry + chunk
+                lines = chunk.split(b"\n")
+                carry = lines.pop()
+                for line in lines:
+                    if i < skip:
+                        i += 1
+                        continue
+                    i += 1
+                    if limit is not None and n >= limit:
+                        return
+                    word = line.rstrip(b"\r\n")
+                    if word:
+                        n += 1
+                        yield word
+            if carry and i >= skip and (limit is None or n < limit):
+                word = carry.rstrip(b"\r\n")
                 if word:
-                    n += 1
                     yield word
         finally:
             if f is not self.source and f is not owned_raw:

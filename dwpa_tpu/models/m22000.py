@@ -659,7 +659,12 @@ class M22000Engine:
         only; the consumer thread stages the result via
         ``_prepare_staged``.  Returns None when the native packer is
         unavailable (the block then takes the full ``_prepare`` path
-        on-thread, unchanged semantics).
+        on-thread, unchanged semantics).  ``pack(words, pre=...)``
+        accepts an already-packed ``(rows, lens, nvalid)`` from the
+        dict cache's warm path and skips the packer (the feed detects
+        this via ``pack.supports_pre``); the store split below still
+        applies, so warm blocks compose with the PMK-store hit/miss
+        dispatch.
 
         With a ``pmk_store`` attached the closure additionally splits the
         packed block into per-ESSID cache hits and misses
@@ -678,10 +683,18 @@ class M22000Engine:
         store = self.pmk_store if jax.process_count() == 1 else None
         essids = list(self._salts) if store is not None else None
 
-        def pack(words):
-            cap = max(bs, -(-len(words) // n) * n)
-            fast = pack_candidates_fast(words, MIN_PSK_LEN, MAX_PSK_LEN,
-                                        capacity=cap)
+        def pack(words, pre=None):
+            # ``pre``: an already-packed (rows, lens, nvalid) from the
+            # dict cache's warm path (feed.dictcache) — identical to
+            # what pack_candidates_fast would return for ``words``, so
+            # the packer is bypassed entirely and only the PMK-store
+            # split (when attached) still runs
+            if pre is not None:
+                fast = pre
+            else:
+                cap = max(bs, -(-len(words) // n) * n)
+                fast = pack_candidates_fast(words, MIN_PSK_LEN, MAX_PSK_LEN,
+                                            capacity=cap)
             if fast is None or store is None:
                 return fast
             packed, lens, nvalid = fast
@@ -691,6 +704,7 @@ class M22000Engine:
 
             return split_block(store, essids, packed, lens, nvalid, bs, n)
 
+        pack.supports_pre = True
         return pack
 
     def _prepare_staged(self, packed, lens, nvalid):
@@ -722,6 +736,13 @@ class M22000Engine:
         prep = getattr(block, "prep", None)
         if prep is None:
             return self._prepare(block.words)
+        if hasattr(prep, "materialize"):
+            # a lazy dict-cache prep (framing.PackedSlices) normally
+            # materializes on the feed's producer threads; blocks
+            # consumed without a feed (direct frame_packed iteration)
+            # materialize here instead — pure host array copies, not
+            # cache file I/O (the mmap was opened producer-side)
+            prep = prep.materialize()
         from ..pmkstore.stage import MixedPrep
 
         if isinstance(prep, MixedPrep):

@@ -542,7 +542,7 @@ def test_dw107_real_feed_tree_is_clean():
     from dwpa_tpu.analysis.linter import lint_file
 
     root = repo_root()
-    for mod in ("__init__", "framing", "pipeline", "staging"):
+    for mod in ("__init__", "framing", "pipeline", "staging", "dictcache"):
         path = os.path.join(root, "dwpa_tpu", "feed", mod + ".py")
         assert [v for v in lint_file(path, root)
                 if v.code == "DW107"] == [], mod
@@ -639,6 +639,76 @@ def test_dw108_real_pmkstore_tree_is_clean():
         path = os.path.join(root, *rel.split("/"))
         assert [v for v in lint_file(path, root)
                 if v.code == "DW108"] == [], rel
+
+
+# ---------------------------------------------------------------------------
+# DW111: packed-dict-cache discipline
+# ---------------------------------------------------------------------------
+
+
+def test_dw111_cache_read_in_traced_region():
+    vs = lint("""
+        import jax
+
+        def step(x, dict_cache):
+            rd = dict_cache.reader("0" * 32)
+            return x
+
+        run = jax.jit(step)
+    """, "dwpa_tpu/feed/seeded.py")
+    assert codes(vs) == ["DW111"]
+    assert "producer-thread host work" in vs[0].detail
+
+
+def test_dw111_cache_io_outside_feed_subsystem():
+    """Cache I/O from client/engine code is the wrong seam — the same
+    source is clean when it lives under dwpa_tpu/feed/."""
+    src = """
+        def warm(self, dhash):
+            return self.dict_cache.reader(dhash)
+    """
+    vs = lint(src, "dwpa_tpu/models/seeded.py")
+    assert codes(vs) == ["DW111"]
+    assert "feed producer threads" in vs[0].detail
+    assert lint(src, "dwpa_tpu/feed/seeded.py") == []
+
+
+def test_dw111_non_cache_receivers_stay_clean():
+    """csv.writer / conn.commit / q.abort share method names with the
+    cache API; the receiver heuristic keeps them out of DW111."""
+    vs = lint("""
+        def host_work(csv, conn, q, f):
+            w = csv.writer(f)
+            conn.commit()
+            q.abort()
+    """, "dwpa_tpu/client/seeded.py")
+    assert vs == []
+
+
+def test_dw111_holding_a_handle_is_not_io():
+    """The client CONSTRUCTS the cache and passes it into the feed —
+    only I/O methods flag, not construction or attribute access."""
+    vs = lint("""
+        from ..feed.dictcache import DictCache
+
+        def setup(cfg, registry):
+            cache = DictCache(cfg.dict_cache_dir, registry=registry)
+            return cache.root
+    """, "dwpa_tpu/client/seeded.py")
+    assert vs == []
+
+
+def test_dw111_real_tree_is_clean():
+    """The shipped dictcache/feed/client wiring obeys its own seam."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    for rel in ("dwpa_tpu/feed/dictcache.py", "dwpa_tpu/feed/pipeline.py",
+                "dwpa_tpu/feed/framing.py", "dwpa_tpu/feed/__init__.py",
+                "dwpa_tpu/client/main.py", "dwpa_tpu/models/m22000.py"):
+        path = os.path.join(root, *rel.split("/"))
+        assert [v for v in lint_file(path, root)
+                if v.code == "DW111"] == [], rel
 
 
 # ---------------------------------------------------------------------------
@@ -1070,7 +1140,7 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
-             "DW108", "DW109", "DW201", "DW202", "DW203", "DW204"}
+             "DW108", "DW109", "DW111", "DW201", "DW202", "DW203", "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

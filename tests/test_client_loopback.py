@@ -306,6 +306,43 @@ def test_pmkstore_metrics_and_warm_unit(server, tmp_path):
     assert 0 < reg.value("dwpa_pmkstore_hit_ratio") <= 1
 
 
+def test_dictcache_metrics_and_warm_unit(server, tmp_path):
+    """Packed-dict-cache loopback contract (the ISSUE-9 acceptance
+    check): with --dict-cache-dir set, the first unit cold-streams the
+    dict while writing the packed cache (misses counted, bytes on
+    disk), and a REPLAY of the same unit serves pass 2 from mmap'd
+    packed blocks — hits recorded, the warm words/s gauge live, the PSK
+    still cracked with the identical found list."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="dc1")])
+    _add_dict(server, [b"cacheable-%06d" % i for i in range(30)] + [PSK])
+    reg = MetricsRegistry()
+    client = _client(server, tmp_path, registry=reg,
+                     dict_cache_dir=str(tmp_path / "dictcache"))
+
+    work = client.api.get_work(client.dictcount)
+    res = client.process_work(dict(work))
+    assert res.accepted and [f.psk for f in res.founds] == [PSK]
+    # cold unit: blocks streamed past the cache, the entry committed
+    assert reg.value("dwpa_dictcache_miss_blocks_total") > 0
+    assert not reg.value("dwpa_dictcache_hit_blocks_total")
+    assert reg.value("dwpa_dictcache_bytes") > 0
+    text = reg.render_prometheus()
+    for name in ("dwpa_dictcache_hit_blocks_total",
+                 "dwpa_dictcache_miss_blocks_total",
+                 "dwpa_dictcache_bytes", "dwpa_dictcache_words_per_s"):
+        assert name in text, name
+
+    # warm replay of the same unit (server-side state reset): pass 2
+    # now serves pre-packed blocks, zero gunzip, zero re-packing
+    server.db.x("UPDATE nets SET n_state = 0, pass = NULL, algo = ''")
+    misses_before = reg.value("dwpa_dictcache_miss_blocks_total")
+    res2 = client.process_work(dict(work))
+    assert res2.accepted and [f.psk for f in res2.founds] == [PSK]
+    assert reg.value("dwpa_dictcache_hit_blocks_total") > 0
+    assert reg.value("dwpa_dictcache_miss_blocks_total") == misses_before
+    assert reg.value("dwpa_dictcache_words_per_s", feed="warm") > 0
+
+
 def test_potfile_fsync_per_found(server, tmp_path, monkeypatch):
     """Potfile appends are flushed AND fsynced per found: a crash right
     after put_work must not lose the only local copy of a cracked PSK

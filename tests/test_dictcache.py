@@ -1,0 +1,379 @@
+"""dwpa_tpu.feed.dictcache: the packed-dictionary cache.
+
+Four layers under test:
+
+- the chunked ``DictStream`` cold path (bit-identical word semantics
+  vs a line-split oracle: lone ``\\r``, CRLF, blank lines, missing
+  trailing newline, skip/limit, carry across chunk boundaries);
+- the CACHE (cold/warm block parity word-for-word against the native
+  packer, ``$HEX`` decode and the 63-byte boundary included;
+  torn-tail and CRC fault injection -> cold fallback, never wrong
+  words; dhash-mismatch invalidation; LRU eviction under the byte
+  cap);
+- the FRAMING twin (``frame_packed`` reproduces ``frame_blocks``
+  geometry and per-host content on a multi-host mesh);
+- the ENGINE warm path — a warm resume must produce the identical
+  found list and consumed counts as the cold stream it replaced.
+"""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from dwpa_tpu import testing as synth
+from dwpa_tpu.feed import CandidateFeed, DictCache, DictFeedSource
+from dwpa_tpu.feed.framing import frame_blocks, frame_packed
+from dwpa_tpu.gen.dicts import DictStream, md5_file
+from dwpa_tpu.models.m22000 import M22000Engine
+from dwpa_tpu.native import pack_candidates_fast
+from dwpa_tpu.obs import MetricsRegistry
+
+HAVE_NATIVE = pack_candidates_fast([b"probeword"], 8, 63,
+                                   capacity=1) is not None
+needs_native = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="native packer unavailable — no warm path")
+
+
+# ---------------------------------------------------------------------------
+# DictStream chunked cold path vs the line-split oracle
+# ---------------------------------------------------------------------------
+
+
+def _oracle(blob, skip=0, limit=None):
+    """The pre-chunking semantics: binary line iteration (split on
+    ``\\n`` only), skip counts line indices INCLUDING blanks, limit
+    counts yielded words, trailing ``\\r\\n`` runs stripped."""
+    lines = blob.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    out = []
+    for i, line in enumerate(lines):
+        if i < skip:
+            continue
+        if limit is not None and len(out) >= limit:
+            break
+        w = line.rstrip(b"\r\n")
+        if w:
+            out.append(w)
+    return out
+
+
+EDGE_BLOBS = [
+    b"",
+    b"\n",
+    b"\n\n\n",
+    b"alpha\r\nbeta\n\ngamma",          # CRLF + blank + no trailing \n
+    b"lone\rcarriage\nnext\n",          # lone \r stays inside its word
+    b"tail-no-newline",
+    b"x" * 63 + b"\n" + b"y" * 64 + b"\nok-word\n",
+    b"a\n\rb\n\n\nc\r\r\n",             # leading \r kept, trailing run gone
+    b"\n".join(b"w%04d" % i for i in range(257)),  # no trailing newline
+]
+
+
+@pytest.mark.parametrize("blob", EDGE_BLOBS, ids=range(len(EDGE_BLOBS)))
+@pytest.mark.parametrize("skip,limit", [(0, None), (1, None), (3, 2),
+                                        (0, 1), (5, None), (1000, None)])
+def test_dictstream_matches_line_oracle(tmp_path, blob, skip, limit):
+    path = os.path.join(str(tmp_path), "d.txt")
+    with open(path, "wb") as f:
+        f.write(blob)
+    got = list(DictStream(path, skip=skip, limit=limit))
+    assert got == _oracle(blob, skip, limit)
+
+
+def test_dictstream_carry_across_tiny_chunks(tmp_path, monkeypatch):
+    """Words spanning chunk boundaries reassemble exactly (CHUNK=5
+    forces a carry on nearly every read), gzip included."""
+    monkeypatch.setattr(DictStream, "CHUNK", 5)
+    blob = b"alpha\r\nbeta\n\ngam\rma\nx" * 7 + b"final-no-nl"
+    path = os.path.join(str(tmp_path), "d.gz")
+    with gzip.open(path, "wb") as f:
+        f.write(blob)
+    assert list(DictStream(path)) == _oracle(blob)
+    assert list(DictStream(path, skip=4, limit=3)) == _oracle(blob, 4, 3)
+
+
+# ---------------------------------------------------------------------------
+# cache: cold/warm parity, fault injection, invalidation, eviction
+# ---------------------------------------------------------------------------
+
+#: a word mix hitting every packer filter edge: $HEX decode (valid,
+#: odd-digit, non-hex — the latter two served as literals), the 8/63
+#: length boundaries, blanks dropped upstream by DictStream
+WORDS = (
+    [b"word-%05d-pw" % i for i in range(1500)]
+    + [b"$HEX[70617373776f72643132]",   # decodes to "password12"
+       b"$HEX[616263]",                 # decodes to 3 bytes: filtered
+       b"$HEX[zzzz]",                   # non-hex: literal, len 10 ok
+       b"short",                        # < 8: filtered
+       b"x" * 63,                       # boundary: kept
+       b"y" * 64,                       # boundary + 1: filtered
+       b"eight888"]
+)
+
+
+def _dict_file(tmp_path, words, tag=b""):
+    """Write a gz dict named ``<dhash>.gz`` (the client's on-disk
+    naming) and return ``(path, dhash)``."""
+    blob = b"\n".join(list(words) + ([tag] if tag else [])) + b"\n"
+    tmp = os.path.join(str(tmp_path), "staging.gz")
+    with gzip.open(tmp, "wb") as f:
+        f.write(blob)
+    dhash = md5_file(tmp)
+    path = os.path.join(str(tmp_path), dhash + ".gz")
+    os.replace(tmp, path)
+    return path, dhash
+
+
+def _collect(units, cache, bs=256, skip=0, nproc=1, pid=0):
+    """Drain a DictFeedSource through CandidateFeed; returns
+    ``[(offset, count, padded, (rows, lens, nvalid) | None, words)]``
+    with materialized preps copied out of the mmap."""
+    src = DictFeedSource(units, batch_size=bs, cache=cache, skip=skip,
+                         nproc=nproc, pid=pid)
+    feed = CandidateFeed(None, batch_size=bs, frames=src, producers=1,
+                         prepack=None, registry=MetricsRegistry())
+    out = []
+    try:
+        for blk in feed:
+            prep = blk.prep
+            if prep is not None:
+                prep = (np.asarray(prep[0]).copy(),
+                        np.asarray(prep[1]).copy(), prep[2])
+            out.append((blk.offset, blk.count, blk.padded, prep,
+                        list(blk.words)))
+    finally:
+        feed.close()
+    return out, src.skipped
+
+
+def _assert_parity(cold, warm, bs):
+    """Warm blocks must carry exactly what the native packer produces
+    for the corresponding cold block's words."""
+    assert len(cold) == len(warm)
+    for (co, cc, cp, _, cw), (wo, wc, wp, wprep, ww) in zip(cold, warm):
+        assert (co, cc, cp) == (wo, wc, wp)
+        assert ww == []                      # warm never decodes words
+        packed = pack_candidates_fast(cw, 8, 63, capacity=bs)
+        if packed is None:                   # all-filtered block
+            assert wprep[2] == 0
+            continue
+        rows, lens, nv = packed
+        assert nv == wprep[2]
+        assert np.array_equal(np.asarray(rows), wprep[0])
+        assert np.array_equal(np.asarray(lens[:nv], np.uint8),
+                              wprep[1][:nv])
+
+
+@needs_native
+def test_cold_then_warm_word_for_word_parity(tmp_path):
+    path, dhash = _dict_file(tmp_path, WORDS)
+    reg = MetricsRegistry()
+    cache = DictCache(os.path.join(str(tmp_path), "dc"), registry=reg)
+    bs = 256
+    cold, _ = _collect([(path, dhash)], cache, bs=bs)
+    assert reg.value("dwpa_dictcache_miss_blocks_total") == len(cold)
+    assert os.path.exists(cache._path(dhash))
+    warm, _ = _collect([(path, dhash)], cache, bs=bs)
+    assert reg.value("dwpa_dictcache_hit_blocks_total") == len(warm)
+    assert reg.value("dwpa_dictcache_words_per_s", feed="warm") > 0
+    _assert_parity(cold, warm, bs)
+
+
+@needs_native
+def test_warm_skip_is_an_index_seek_with_cold_parity(tmp_path):
+    """Resume skips — mid-dict, across the dict boundary, beyond all
+    words — produce identical blocks warm and cold, and identical
+    ``skipped`` accounting."""
+    p1, h1 = _dict_file(tmp_path, WORDS)
+    p2, h2 = _dict_file(tmp_path, WORDS[:301], tag=b"second-dict")
+    units = [(p1, h1), (p2, h2)]
+    total = len(WORDS) + 302
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    bs = 256
+    _collect(units, cache, bs=bs)  # populate
+    for skip in (0, 100, len(WORDS) - 1, len(WORDS), len(WORDS) + 5,
+                 total - 1, total, total + 99):
+        cold, csk = _collect(units, None, bs=bs, skip=skip)
+        warm, wsk = _collect(units, cache, bs=bs, skip=skip)
+        assert csk == wsk == min(skip, total), skip
+        _assert_parity(cold, warm, bs)
+        if skip < total:
+            assert warm[0][0] == skip
+
+
+@needs_native
+def test_torn_tail_falls_back_cold_with_correct_words(tmp_path):
+    path, dhash = _dict_file(tmp_path, WORDS)
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    cold, _ = _collect([(path, dhash)], cache)
+    entry = cache._path(dhash)
+    size = os.path.getsize(entry)
+    with open(entry, "r+b") as f:
+        f.truncate(size - 13)          # mid-frame, not a boundary
+    assert cache.reader(dhash) is None
+    again, _ = _collect([(path, dhash)], cache)
+    assert [b[4] for b in again] == [b[4] for b in cold]  # words intact
+
+
+@needs_native
+def test_crc_corruption_falls_back_cold(tmp_path):
+    path, dhash = _dict_file(tmp_path, WORDS)
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    cold, _ = _collect([(path, dhash)], cache)
+    entry = cache._path(dhash)
+    with open(entry, "r+b") as f:
+        f.seek(os.path.getsize(entry) // 2)  # inside some chunk payload
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert cache.reader(dhash) is None
+    again, _ = _collect([(path, dhash)], cache)
+    assert [b[4] for b in again] == [b[4] for b in cold]
+
+
+@needs_native
+def test_dhash_mismatch_invalidates(tmp_path):
+    """An entry keyed to different dict bytes (the regenerated-dict
+    case: same path shape, new dhash) must read as a miss — the
+    embedded-dhash check, independent of the filename."""
+    path, dhash = _dict_file(tmp_path, WORDS)
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    _collect([(path, dhash)], cache)
+    other = "f" * 32
+    os.replace(cache._path(dhash), cache._path(other))
+    assert cache.reader(other) is None
+    assert cache.reader(dhash) is None   # original file is gone too
+    assert cache.reader("not-a-dhash") is None
+
+
+@needs_native
+def test_eviction_under_byte_cap_is_lru(tmp_path):
+    reg = MetricsRegistry()
+    cache = DictCache(os.path.join(str(tmp_path), "dc"), registry=reg)
+    units = []
+    for k in range(3):
+        p, h = _dict_file(tmp_path, WORDS[:900], tag=b"evict-%d" % k)
+        units.append((p, h))
+        _collect([(p, h)], cache)
+    sizes = {h: os.path.getsize(cache._path(h)) for _, h in units}
+    # touch dict 0 so dict 1 becomes the LRU victim
+    assert cache.reader(units[0][1]) is not None
+    cache.max_bytes = sum(sizes.values()) - 1   # forces one eviction
+    cache.evict()
+    assert cache.reader(units[1][1]) is None    # LRU victim gone
+    assert cache.reader(units[0][1]) is not None
+    assert cache.reader(units[2][1]) is not None
+    assert cache._bytes_used() <= cache.max_bytes
+    assert reg.value("dwpa_dictcache_bytes") == cache._bytes_used()
+
+
+@needs_native
+def test_partial_consume_never_commits(tmp_path):
+    """Breaking out of a cold stream mid-dict (fault, shutdown) must
+    abort the cache write — a partial entry served warm would silently
+    truncate the keyspace."""
+    path, dhash = _dict_file(tmp_path, WORDS)
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    src = DictFeedSource([(path, dhash)], batch_size=64, cache=cache)
+    for blk in src:
+        break                            # consumer dies after one block
+    assert cache.reader(dhash) is None
+    assert not os.path.exists(cache._path(dhash))
+    assert [f for f in os.listdir(cache.root) if ".tmp-" in f] == []
+
+
+def test_native_packer_absent_stays_cold_and_correct(tmp_path):
+    """Without the native packer there is nothing coherent to cache:
+    writer() declines, no file appears, and the cold stream is
+    untouched."""
+    path, dhash = _dict_file(tmp_path, WORDS[:50])
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    cache._native_ok = False
+    assert cache.writer(dhash) is None
+    blocks, _ = _collect([(path, dhash)], cache)
+    assert [w for b in blocks for w in b[4]] == WORDS[:50]
+    assert not os.path.exists(cache._path(dhash))
+
+
+# ---------------------------------------------------------------------------
+# frame_packed: the multi-host framing twin
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_frame_packed_matches_frame_blocks_per_host(tmp_path):
+    """nproc=2: every host's warm blocks must carry the same geometry
+    and packed content as its cold ``frame_blocks`` slice — the
+    SPMD-lockstep contract, cache-state-independent."""
+    path, dhash = _dict_file(tmp_path, WORDS[:1000])
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    bs = 128
+    _collect([(path, dhash)], cache, bs=bs)   # populate (nproc=1 tee)
+    rd = cache.reader(dhash)
+    for pid in (0, 1):
+        cold = list(frame_blocks(iter(WORDS[:1000]), bs, nproc=2, pid=pid))
+        warm = list(frame_packed(rd.chunks(0), rd.total_words, bs,
+                                 nproc=2, pid=pid))
+        assert len(cold) == len(warm)
+        for cb, wb in zip(cold, warm):
+            assert (cb.offset, cb.count, cb.padded) == \
+                (wb.offset, wb.count, wb.padded)
+            rows, lens, nv = wb.prep.materialize()
+            packed = pack_candidates_fast(cb.words, 8, 63, capacity=bs)
+            assert nv == packed[2]
+            assert np.array_equal(np.asarray(packed[0]), rows)
+
+
+# ---------------------------------------------------------------------------
+# engine warm path: resume/found-list equivalence
+# ---------------------------------------------------------------------------
+
+PSK = b"dcache-psk-42"
+ESSID = b"DictCacheNet"
+
+
+def _crack_via_source(engine, units, cache, skip=0):
+    consumed = []
+    src = DictFeedSource(units, batch_size=engine.batch_size,
+                         cache=cache, skip=skip)
+    feed = CandidateFeed(None, batch_size=engine.batch_size, frames=src,
+                         producers=1, prepack=engine.host_packer(),
+                         registry=MetricsRegistry())
+    try:
+        founds = engine.crack_blocks(
+            feed, on_batch=lambda c, f: consumed.append(c))
+    finally:
+        feed.close()
+    return founds, consumed
+
+
+@needs_native
+def test_engine_warm_run_equals_cold_run(tmp_path):
+    """The acceptance property: found list AND consumed counts from a
+    warm unit are identical to the cold unit it replaced — with and
+    without a resume skip."""
+    words = [b"engine-%04d-word" % i for i in range(100)] + [PSK]
+    path, dhash = _dict_file(tmp_path, words)
+    units = [(path, dhash)]
+    line = synth.make_pmkid_line(PSK, ESSID, seed="dc1")
+    cache = DictCache(os.path.join(str(tmp_path), "dc"))
+    for skip in (0, 37):
+        cold = _crack_via_source(M22000Engine([line], batch_size=32),
+                                 units, None, skip=skip)
+        got = _crack_via_source(M22000Engine([line], batch_size=32),
+                                units, cache, skip=skip)
+        assert [f.psk for f in got[0]] == [f.psk for f in cold[0]] == [PSK]
+        assert got[1] == cold[1]
+        assert sum(got[1]) == len(words) - skip
+    # by now the cache is warm: one more pass must be hit-served
+    reg = MetricsRegistry()
+    cache2 = DictCache(cache.root, registry=reg)
+    got = _crack_via_source(M22000Engine([line], batch_size=32),
+                            units, cache2)
+    assert [f.psk for f in got[0]] == [PSK]
+    assert reg.value("dwpa_dictcache_hit_blocks_total") > 0
+    assert reg.value("dwpa_dictcache_miss_blocks_total") == 0
