@@ -697,6 +697,115 @@ def bench_device_streams(batch: int = None, batches: int = 12) -> dict:
             "recompiles_warm": comp.count}
 
 
+def bench_resilience(batch: int = None, words: int = 20_000,
+                     fault_rate: float = 0.10, seed: int = 10) -> dict:
+    """Crack-loop throughput under transport faults (resilient transport
+    + found outbox).
+
+    Three loopback work units over the same dict geometry: a warmup leg
+    (pays the compiles), a fault-free reference leg, and a leg under a
+    seeded ``fault_rate`` schedule (drop/timeout/http_5xx/slow) plus a
+    forced put_work reject redriven through the found outbox.  Backoff
+    and circuit cooldowns run on the chaos VirtualClock, so the faulted
+    leg's wall time is crack work plus fault *handling* only — the
+    degraded loop must never park the devices behind a real backoff
+    sleep.  Tracks ``retention`` (faulted PMK/s over clean PMK/s;
+    acceptance floor 0.8) and ``recompiles_faulted`` (must stay 0:
+    fault handling is host logic and must not perturb device shapes).
+    """
+    import gzip as _gzip
+    import hashlib as _hashlib
+    import random as _random
+    import tempfile
+
+    from dwpa_tpu.chaos import (ChaosTransport, FaultPlan, VirtualClock,
+                                WsgiTransport)
+    from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+    from dwpa_tpu.client.protocol import CircuitBreaker, ServerAPI
+    from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+    if batch is None:
+        batch = 131072 if ON_TPU else 2048
+    batch = min(batch, max(256, words // 4))
+    psk = b"benchpass-res1"
+    wordlist = [b"resword%07d" % i for i in range(words - 1)] + [psk]
+    blob = _gzip.compress(b"\n".join(wordlist) + b"\n")
+    dhash = _hashlib.md5(blob).hexdigest()
+
+    def build_server(td):
+        core = ServerCore(Database(":memory:"),
+                          dictdir=os.path.join(td, "dicts"),
+                          capdir=os.path.join(td, "caps"))
+        core.add_hashlines([T.make_pmkid_line(psk, b"bench-res",
+                                              seed="res1")])
+        core.db.x("UPDATE nets SET algo = ''")
+        os.makedirs(core.dictdir, exist_ok=True)
+        with open(os.path.join(core.dictdir, "res.txt.gz"), "wb") as f:
+            f.write(blob)
+        core.add_dict("dict/res.txt.gz", "res.txt.gz", dhash,
+                      len(wordlist), rules=None)
+        return core
+
+    def run_leg(td, plan, span):
+        """One full work unit (get_work -> crack -> submit) under
+        ``plan``; returns (result, seconds, client, clock)."""
+        clock = VirtualClock()
+        api = ServerAPI("http://loopback/", max_tries=0, backoff=2.0,
+                        sleep=clock.sleep, rng=_random.Random(seed),
+                        breaker=CircuitBreaker(threshold=5, cooldown=4.0,
+                                               clock=clock.now))
+        api.retry.clock = clock.now
+        api._transport = ChaosTransport(
+            WsgiTransport(make_wsgi_app(build_server(td))), plan,
+            sleep=clock.sleep)
+        cfg = ClientConfig(base_url="http://loopback/",
+                           workdir=os.path.join(td, "work"),
+                           batch_size=batch, dictcount=1,
+                           device_streams="off")
+        client = TpuCrackClient(cfg, api=api, log=lambda *a, **k: None)
+        work = client.api.get_work(1)
+        box = {}
+        s = _timed(lambda: box.setdefault("res", client.process_work(work)),
+                   span)
+        return box["res"], s, client, clock
+
+    with tempfile.TemporaryDirectory() as td:
+        run_leg(os.path.join(td, "warm"), FaultPlan(seed),
+                "bench:resilience_warmup")
+        res0, clean_s, _, _ = run_leg(os.path.join(td, "clean"),
+                                      FaultPlan(seed),
+                                      "bench:resilience_clean")
+        plan = FaultPlan(seed, rate=fault_rate,
+                         kinds=("drop", "timeout", "http_5xx", "slow"))
+        plan.force("put_work", "reject")
+        with watch_compiles() as comp:
+            res1, fault_s, client1, clock1 = run_leg(
+                os.path.join(td, "chaos"), plan, "bench:resilience")
+        # The rejected submission sits in the outbox; redrive until the
+        # seeded schedule lets a clean exchange through.
+        for _ in range(25):
+            if not client1.outbox.pending_count():
+                break
+            clock1.sleep(client1.api.breaker.cooldown)
+            try:
+                client1._drain_outbox()
+            except ConnectionError:
+                continue
+
+    n = res0.candidates_tried
+    faults = [k for _, _, k in plan.schedule() if k is not None]
+    return {"label": "resilience", "words": words, "batch": batch,
+            "fault_rate": fault_rate,
+            "clean_seconds": clean_s, "faulted_seconds": fault_s,
+            "clean_pmk_per_s": n / clean_s,
+            "faulted_pmk_per_s": res1.candidates_tried / fault_s,
+            "retention": (res1.candidates_tried / fault_s) / (n / clean_s),
+            "faults_injected": len(faults),
+            "founds_delivered": bool(res0.founds) and bool(res1.founds)
+            and client1.outbox.pending_count() == 0,
+            "recompiles_faulted": comp.count}
+
+
 def _timed(fn, name: str = "bench:timed") -> float:
     """One rep as a span: the body must sync its own device work (every
     caller passes an engine crack* call, which does)."""
@@ -820,6 +929,7 @@ def main():
     small_units = bench_small_units()
     streams = bench_device_streams()
     overhead = bench_unit_overhead(pmkid)
+    resilience = bench_resilience(batch)
 
     value = mask["pmk_per_s"]
     print(
@@ -846,6 +956,7 @@ def main():
                     "small_units": _round(small_units),
                     "device_streams": _round(streams),
                     "unit_overhead": _round(overhead),
+                    "resilience": _round(resilience),
                 },
             }
         )

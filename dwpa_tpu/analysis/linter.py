@@ -104,6 +104,15 @@ Rule codes (stable — referenced by baseline.json and the docs):
   feed's source lock), the same seam discipline as DW107/DW108; client
   or engine code touching the cache directly would put file I/O on the
   consumer's dispatch path.
+- **DW112 client-transport-confinement** — the resilient-transport
+  contract (``dwpa_tpu/client/``, every file except ``protocol.py``):
+  (a) no ``urllib`` import — a raw HTTP exchange outside ``ServerAPI``
+  bypasses error classification, retry backoff, the circuit breaker
+  and the outbox-backed submission path; (b) no bare ``time.sleep``
+  call (nor ``from time import sleep``) — every nap must go through
+  the injected ``api.sleep`` so chaos runs drive a virtual clock and
+  the degraded-mode crack loop can never be parked on a hidden
+  blocking sleep (``time.perf_counter`` and friends stay fine).
 
 The linter is repo-native, not general-purpose: rules are scoped to the
 paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
@@ -125,6 +134,11 @@ OPS_DIRS = ("dwpa_tpu/ops",)
 #: files whose obs spans DW106 polices for the device-sync rule (the
 #: span-instrumented surfaces; the in-trace emission check is global)
 SPAN_FILES = ("bench.py", "dwpa_tpu/client/main.py")
+
+#: the package whose transport confinement DW112 polices, and the one
+#: file inside it allowed to speak raw HTTP / own the backoff sleeps
+CLIENT_DIR = "dwpa_tpu/client/"
+CLIENT_TRANSPORT_FILE = "dwpa_tpu/client/protocol.py"
 
 #: metric-emission methods DW106 bans inside traced functions
 OBS_EMIT_METHODS = {"inc", "dec", "observe", "set"}
@@ -987,6 +1001,55 @@ def _check_stream_discipline(tree, path, src_lines, out):
                 _line(src_lines, node)))
 
 
+def _check_client_transport(tree, path, src_lines, out):
+    """DW112: transport confinement in the client package (every file
+    under ``CLIENT_DIR`` except ``CLIENT_TRANSPORT_FILE``).
+
+    (a) any ``urllib`` import — raw HTTP outside ``ServerAPI`` bypasses
+    error classification, retry backoff, the circuit breaker and the
+    outbox-backed submission path; (b) a bare ``time.sleep(...)`` call
+    or ``from time import sleep`` — naps must be the injected
+    ``api.sleep`` so chaos runs drive a virtual clock and the degraded
+    crack loop is never parked on a hidden blocking sleep."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "urllib" for a in node.names):
+                out.append(Violation(
+                    "DW112", path, node.lineno,
+                    "urllib import outside client/protocol.py — raw HTTP "
+                    "here bypasses the retry/classification/circuit-"
+                    "breaker stack; route the call through ServerAPI",
+                    _line(src_lines, node)))
+        elif isinstance(node, ast.ImportFrom):
+            root_mod = (node.module or "").split(".")[0]
+            if root_mod == "urllib":
+                out.append(Violation(
+                    "DW112", path, node.lineno,
+                    "urllib import outside client/protocol.py — raw HTTP "
+                    "here bypasses the retry/classification/circuit-"
+                    "breaker stack; route the call through ServerAPI",
+                    _line(src_lines, node)))
+            elif (root_mod == "time"
+                  and any(a.name == "sleep" for a in node.names)):
+                out.append(Violation(
+                    "DW112", path, node.lineno,
+                    "time.sleep imported outside client/protocol.py — "
+                    "naps must go through the injected api.sleep so the "
+                    "chaos harness can drive them off a virtual clock",
+                    _line(src_lines, node)))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                    and _recv_name(f) == "time"):
+                out.append(Violation(
+                    "DW112", path, node.lineno,
+                    "bare time.sleep() outside client/protocol.py — the "
+                    "crack loop must nap through the injected api.sleep "
+                    "(virtual-clock testable, and degraded mode is never "
+                    "blocked behind a hidden sleep)",
+                    _line(src_lines, node)))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1023,6 +1086,8 @@ def lint_source(src: str, path: str) -> list:
         _check_fused_pad_widths(tree, path, src_lines, out)
     if path in STREAM_FILES:
         _check_stream_discipline(tree, path, src_lines, out)
+    if path.startswith(CLIENT_DIR) and path != CLIENT_TRANSPORT_FILE:
+        _check_client_transport(tree, path, src_lines, out)
     return out
 
 

@@ -1,0 +1,26 @@
+"""Deterministic chaos harness: seeded fault schedules for every layer.
+
+PRs 7–9 each grew their own ad-hoc fault plumbing (feed, pmkstore,
+dictcache, streams); this package unifies it.  One seeded
+:class:`FaultPlan` decides, call by call, which fault (if any) a
+:class:`ChaosTransport` injects under the real retry stack, and
+``fsfault`` provides torn-write/short-read injection for the journal
+formats.  Everything is driven by explicit seeds and virtual clocks —
+the same seed replays the identical fault schedule, so a soak failure
+is a one-line repro, not a flake.
+"""
+
+from .plan import FAULT_KINDS, FaultPlan
+from .transport import ChaosTransport, VirtualClock, WsgiTransport
+from .fsfault import FsFaultInjector, flip_byte, tear_tail
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultPlan",
+    "ChaosTransport",
+    "VirtualClock",
+    "WsgiTransport",
+    "FsFaultInjector",
+    "flip_byte",
+    "tear_tail",
+]

@@ -67,6 +67,28 @@ def build_parser():
     p.add_argument("--fuse-max-units", type=int, default=8,
                    help="max work units packed into one fused device "
                         "batch (one salt-table row per ESSID)")
+    p.add_argument("--max-tries", type=int, default=0,
+                   help="transport attempts per server call before giving "
+                        "up (0 = retry forever, reference behavior; "
+                        "README 'Resilience')")
+    p.add_argument("--backoff", type=float, default=123.0,
+                   help="retry base delay in seconds, also the idle "
+                        "(No nets) nap (reference interval 123)")
+    p.add_argument("--retry-cap", type=float, default=None,
+                   help="max retry delay for the decorrelated-jitter "
+                        "exponential backoff (default: flat at --backoff, "
+                        "reference parity; set higher, e.g. --backoff 2 "
+                        "--retry-cap 120, for the ramp)")
+    p.add_argument("--outbox-dir",
+                   help="durable found-outbox directory: cracked PSKs "
+                        "are journaled there before submission and "
+                        "drained at startup/between units (default: "
+                        "<workdir>/outbox)")
+    p.add_argument("--prefetch-units", type=int, default=0,
+                   help="extra work units leased ahead while the server "
+                        "is reachable and cracked while the transport "
+                        "circuit is OPEN (degraded mode; 0 = off, "
+                        "single-host only)")
     p.add_argument("--multihost", action="store_true",
                    help="join a jax.distributed slice before any engine "
                         "work (TPU pod environment auto-detected); the "
@@ -119,6 +141,11 @@ def main(argv=None):
         unit_queue=args.unit_queue,
         fuse_max_units=args.fuse_max_units,
         device_streams=args.device_streams,
+        max_tries=args.max_tries,
+        backoff=args.backoff,
+        retry_cap=args.retry_cap,
+        outbox_dir=args.outbox_dir,
+        prefetch_units=args.prefetch_units,
     )
     TpuCrackClient(cfg).run()
 

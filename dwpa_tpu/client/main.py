@@ -38,10 +38,12 @@ from ..models.m22000 import M22000Engine
 from ..obs import (SpanTracer, default_registry, get_logger, is_emitter,
                    merged_slice_snapshot, setup_logging)
 from ..rules import apply_rules, parse_rules
+from ..utils.fsio import fsync_replace
 from .. import __version__
 from .. import testing as synth
 from ..oracle import m22000 as oracle
-from .protocol import NoNets, ServerAPI
+from .outbox import FoundOutbox
+from .protocol import NoNets, PermanentError, ServerAPI, VersionRejected
 from .targeted import targeted_candidates
 
 PACE_TARGET_S = 900.0  # work-unit pacing target (reference autotune threshold)
@@ -177,6 +179,20 @@ class ClientConfig:
                                     # shard_map dispatch ("auto": streams
                                     # on single-process multi-device,
                                     # lockstep elsewhere; "on"/"off" force)
+    max_tries: int = 0              # --max-tries: transport attempts per
+                                    # call (0 = retry forever, reference
+                                    # behavior)
+    backoff: float = 123.0          # --backoff: retry base delay; also
+                                    # the idle (No nets) nap
+    retry_cap: float = None         # --retry-cap: max retry delay for the
+                                    # decorrelated-jitter ramp (None =
+                                    # flat at --backoff, reference parity)
+    outbox_dir: str = None          # --outbox-dir: durable found outbox
+                                    # journal dir (default workdir/outbox)
+    prefetch_units: int = 0         # --prefetch-units: extra work units
+                                    # leased ahead while the transport is
+                                    # healthy, cracked while it is OPEN
+                                    # (degraded mode; single-host only)
 
 
 @dataclass
@@ -192,7 +208,9 @@ class TpuCrackClient:
     def __init__(self, config: ClientConfig, api: ServerAPI = None, log=None,
                  registry=None):
         self.cfg = config
-        self.api = api or ServerAPI(config.base_url)
+        self.api = api or ServerAPI(
+            config.base_url, max_tries=config.max_tries,
+            backoff=config.backoff, retry_cap=config.retry_cap)
         if log is None:
             # one logging config for the whole process (obs.setup_logging
             # is idempotent); DWPA_LOG=json switches to structured lines
@@ -267,6 +285,17 @@ class TpuCrackClient:
         os.makedirs(config.workdir, exist_ok=True)
         self.dictdir = os.path.join(config.workdir, "dicts")
         os.makedirs(self.dictdir, exist_ok=True)
+        # Durable found outbox: every found is journaled before its first
+        # put_work attempt and drained at startup/between units, so a
+        # crash or server outage between crack and ack cannot lose a PSK.
+        # All hosts open a journal (cheap); only process 0 — the slice's
+        # server voice — ever records or drains.
+        self.outbox = FoundOutbox(
+            config.outbox_dir or os.path.join(config.workdir, "outbox"),
+            registry=self.registry)
+        # Degraded-mode unit buffer (_prefetch_units): units leased ahead
+        # while the transport is healthy, cracked while it is OPEN.
+        self._unit_buffer = []
         # Cold-start: persist XLA compilations under the workdir so a
         # restarted client skips the ~20-40 s PBKDF2 compile (SURVEY §5.4
         # resume latency; tracked by bench.py unit_overhead).
@@ -487,10 +516,16 @@ class TpuCrackClient:
         work["_ver"] = __version__
         work["_nproc"] = jax.process_count()
         work["_batch"] = self.cfg.batch_size
+        # fsync file AND directory around the replace (utils.fsio): a
+        # bare os.replace is atomic against crashes of this process but
+        # not against power loss — the rename can reach disk before the
+        # tmp file's data, resurrecting an older-but-valid checkpoint
+        # whose skip count double-counts candidates never re-tried.
         tmp = self.resume_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(work, f)
-        os.replace(tmp, self.resume_path)
+            f.flush()
+        fsync_replace(tmp, self.resume_path)
 
     def _clear_resume(self):
         if os.path.exists(self.resume_path):
@@ -992,7 +1027,9 @@ class TpuCrackClient:
             acc = err = None
             if jax.process_index() == 0:
                 try:
-                    acc = self.api.put_work(work["hkey"], cand)
+                    acc = self._submit(work["hkey"], cand)
+                except ConnectionError:
+                    acc = False  # journaled; the outbox drain retries
                 except Exception as e:
                     err = f"{type(e).__name__}: {e}"
             payload = _broadcast_json({"acc": acc, "err": err})
@@ -1001,12 +1038,81 @@ class TpuCrackClient:
                     f"put_work failed on host 0: {payload['err']}")
             result.accepted = bool(payload["acc"])
         else:
-            result.accepted = self.api.put_work(work["hkey"], cand)
+            try:
+                result.accepted = self._submit(work["hkey"], cand)
+            except ConnectionError as e:
+                # Degraded mode: the founds were journaled before the
+                # attempt — delivery now belongs to the outbox drain, so
+                # a dead server costs this unit an "accepted" flag, not
+                # the PSKs and not a parked crack loop.
+                if cand:
+                    self.log(f"put_work failed ({e}); "
+                             f"{len(cand)} found(s) wait in the outbox")
+                result.accepted = False
         self._m_units.labels(
             accepted="true" if result.accepted else "false").inc()
         self._clear_resume()
         self._autotune(elapsed)
         return result
+
+    def _submit_tries(self) -> int:
+        """Transport attempts per submission call.  With the outbox
+        guaranteeing delivery, an unbounded (reference-style) retry would
+        only park the crack loop — bound it; an explicit --max-tries is
+        honored as-is."""
+        return self.api.max_tries or 2
+
+    def _submit(self, hkey: str, cand: list) -> bool:
+        """Journal-then-send one unit's founds; acks on server OK.
+
+        The outbox ``record`` is the durability point — it fsyncs before
+        the first ``put_work`` attempt and drops any (hkey, bssid) the
+        server already acked, so a resume-replay re-crack after a
+        restart cannot double-submit."""
+        to_send = self.outbox.record(hkey, cand)
+        if not to_send:
+            # Nothing the server doesn't already have (all acked, or an
+            # empty unit): an empty submission still reports the unit.
+            if cand:
+                return True
+            return self.api.put_work(hkey, cand,
+                                     max_tries=self._submit_tries())
+        accepted = self.api.put_work(hkey, to_send,
+                                     max_tries=self._submit_tries())
+        if accepted:
+            self.outbox.ack(hkey, to_send)
+        return accepted
+
+    def _drain_outbox(self):
+        """Deliver journaled founds left over from crashes/outages —
+        called at startup and between units; stops (and stays pending)
+        on the first transport failure."""
+        if jax.process_index() != 0 or not self.outbox.pending_count():
+            return
+        delivered = self.outbox.drain(
+            lambda hkey, cand: self.api.put_work(
+                hkey, cand, max_tries=self._submit_tries()))
+        if delivered:
+            self.log(f"outbox: delivered {delivered} journaled found(s)")
+        left = self.outbox.pending_count()
+        if left:
+            self.log(f"outbox: {left} found(s) still pending delivery")
+
+    def _prefetch_units(self):
+        """Top the degraded-mode buffer up to ``prefetch_units`` extra
+        leased units while the transport is healthy, so an OPEN circuit
+        still has queued work to crack (single-host only: a slice's
+        lockstep collectives need one agreed unit at a time)."""
+        if jax.process_count() > 1 or self.cfg.prefetch_units <= 0:
+            return
+        while (len(self._unit_buffer) < self.cfg.prefetch_units
+               and not self.api.circuit_open):
+            try:
+                self._unit_buffer.append(
+                    self.api.get_work(self.dictcount, max_tries=1))
+            except (NoNets, VersionRejected, ConnectionError, ValueError,
+                    OSError):
+                break  # best-effort: the serial path needs no buffer
 
     def _autotune(self, elapsed: float):
         if elapsed < self.cfg.pace_target and self.dictcount < 15:
@@ -1136,8 +1242,17 @@ class TpuCrackClient:
             raise SystemExit("challenge failed: cracker output untrusted")
         done = 0
         while not self.cfg.max_work_units or done < self.cfg.max_work_units:
+            # Founds journaled by a previous crash/outage go first: the
+            # outbox drains at startup and between units, and a drain
+            # stopped by a transport failure just retries next round.
+            try:
+                self._drain_outbox()
+            except (ConnectionError, ValueError):
+                pass
             if not multiproc:
                 work = self._read_resume()
+                if work is None and self._unit_buffer:
+                    work = self._unit_buffer.pop(0)
                 if work is None:
                     try:
                         work = self.api.get_work(self.dictcount)
@@ -1145,6 +1260,7 @@ class TpuCrackClient:
                         self.log("no nets available; sleeping")
                         self.api.sleep(self.api.backoff)
                         continue
+                self._prefetch_units()
             else:
                 # Host-0 server errors (version gate, malformed work)
                 # must reach every host as a sentinel: the peers are
@@ -1171,7 +1287,31 @@ class TpuCrackClient:
             if multiproc:
                 res = self.process_work(work)
             else:
-                res = self._process_with_recovery(work)
+                try:
+                    res = self._process_with_recovery(work)
+                except PermanentError as e:
+                    # A 4xx mid-unit (a dict the server no longer serves,
+                    # say) will not heal on replay: abandon the unit —
+                    # the server's lease reap reassigns it — instead of
+                    # resuming into the same rejection forever.
+                    self._clear_resume()
+                    self.log(f"permanent transport failure mid-unit: {e}; "
+                             "abandoning unit")
+                    continue
+                except ConnectionError as e:
+                    # Transport died mid-unit (say, a dict fetch against
+                    # a cold cache while the server is down).  The unit
+                    # is checkpointed in the resume file — nap until the
+                    # circuit's next probe slot, then replay it; any
+                    # founds already cracked sit safely in the outbox.
+                    nap = self.api.backoff
+                    breaker = getattr(self.api, "breaker", None)
+                    if breaker is not None and breaker.remaining() > 0:
+                        nap = breaker.remaining()
+                    self.log(f"transport failure mid-unit: {e}; "
+                             f"resuming in {nap:.0f}s")
+                    self.api.sleep(nap)
+                    continue
                 if res is None:
                     continue  # unit requeued (resume file) or abandoned
             done += 1

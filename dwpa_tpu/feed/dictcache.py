@@ -61,6 +61,8 @@ import zlib
 
 import numpy as np
 
+from ..utils.fsio import fsync_dir
+
 MAGIC = b"DWDCCH1\n"
 FRAME_MAGIC = b"DCTF"
 END_MAGIC = b"DCTE"
@@ -248,7 +250,15 @@ class DictCacheWriter:
 
     def commit(self) -> bool:
         """Seal (END frame), fsync, and atomically publish the entry;
-        returns False if the write failed anywhere along the way."""
+        returns False if the write failed anywhere along the way.
+
+        The directory is fsynced after the replace so the publish
+        itself survives power loss — without it the rename can vanish
+        and leave the fsynced data orphaned under the tmp name.  (For a
+        cache that only costs a re-stream, but the END-frame contract
+        promises "either absent or complete", so the commit path keeps
+        the full durable-rename idiom — see the fsync audit notes in
+        ``utils.fsio``.)"""
         if self.failed or self.committed:
             return self.committed
         try:
@@ -261,6 +271,7 @@ class DictCacheWriter:
             self._f.close()
             self._f = None
             os.replace(self._tmp, self._final)
+            fsync_dir(os.path.dirname(os.path.abspath(self._final)))
             self.committed = True
             self._cache._committed()
             return True

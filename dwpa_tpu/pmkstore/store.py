@@ -245,6 +245,14 @@ class PMKStore:
         layout, fetched host-side first) or an iterable of 32-byte PMK
         strings.  Already-cached digests are skipped, the rest land in
         ONE CRC frame; rotation and eviction run after the append.
+
+        Deliberately flush-only, no fsync (fsync-audit decision, vs the
+        found outbox / resume file which DO pay for it): this is a
+        recompute cache on the hot crack path — a power loss tearing
+        the last frame costs re-deriving those PMKs, never correctness,
+        because the load walk stops at the first bad CRC.  An fsync per
+        appended frame would serialize the crack loop on disk latency
+        for data that is by definition reproducible.
         """
         pmk_list = self._pmk_bytes(pmks, len(words))
         with self._lock:

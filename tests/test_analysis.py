@@ -712,6 +712,73 @@ def test_dw111_real_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# DW112: client transport confinement
+# ---------------------------------------------------------------------------
+
+
+def test_dw112_flags_raw_transport_in_client():
+    src = """
+        import time
+        import urllib.request
+
+        def nap_and_poll(url):
+            time.sleep(5)
+            return urllib.request.urlopen(url).read()
+    """
+    vs = lint(src, "dwpa_tpu/client/watchdog.py")
+    assert codes(vs) == ["DW112", "DW112"]
+    assert "urllib" in vs[0].detail and "time.sleep" in vs[1].detail
+    # protocol.py IS the transport seam; outside the client package the
+    # rule does not apply at all
+    assert lint(src, "dwpa_tpu/client/protocol.py") == []
+    assert lint(src, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw112_flags_from_imports():
+    assert codes(lint("""
+        from urllib.request import urlopen
+
+        def poll(url):
+            return urlopen(url).read()
+    """, "dwpa_tpu/client/main.py")) == ["DW112"]
+    assert codes(lint("""
+        from time import sleep
+
+        def nap():
+            sleep(2)
+    """, "dwpa_tpu/client/main.py")) == ["DW112"]
+
+
+def test_dw112_allows_injected_sleep_and_perf_counter():
+    """The sanctioned idioms stay clean: the injected api.sleep (however
+    the api object is reached) and time's non-blocking clock calls."""
+    assert lint("""
+        import time
+
+        def loop(self):
+            t0 = time.perf_counter()
+            self.api.sleep(self.api.backoff)
+            api = self.api
+            api.sleep(1.0)
+            return time.perf_counter() - t0
+    """, "dwpa_tpu/client/main.py") == []
+
+
+def test_dw112_real_tree_is_clean():
+    """The shipped client package obeys its own transport seam."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    client_dir = os.path.join(root, "dwpa_tpu", "client")
+    for name in sorted(os.listdir(client_dir)):
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(client_dir, name)
+        assert [v for v in lint_file(path, root)
+                if v.code == "DW112"] == [], name
+
+
+# ---------------------------------------------------------------------------
 # DW109: fused-pad-width discipline
 # ---------------------------------------------------------------------------
 
@@ -1140,7 +1207,8 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
-             "DW108", "DW109", "DW111", "DW201", "DW202", "DW203", "DW204"}
+             "DW108", "DW109", "DW111", "DW112", "DW201", "DW202", "DW203",
+             "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

@@ -1,0 +1,477 @@
+"""Chaos-harness tests: retry policy, circuit breaker, fault transports,
+and the seeded end-to-end soak.
+
+Fast tests pin the unit behavior of every resilience primitive
+(``RetryPolicy``, ``CircuitBreaker``, ``classify_error``, ``FaultPlan``,
+``ChaosTransport``) plus the loopback 4xx/5xx classification contract.
+The ``slow``-marked soak runs a full loopback work unit under a seeded
+fault schedule — at least one timeout, 5xx, truncated body, put_work
+reject and a mid-unit client restart — and asserts parity with the
+fault-free run: no founds lost, no duplicate accepted submissions,
+identical fault schedule from the same seed, clean thread teardown.
+"""
+
+import gzip
+import hashlib
+import os
+import random
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.chaos import (ChaosTransport, FaultPlan, VirtualClock,
+                            WsgiTransport)
+from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+from dwpa_tpu.client.protocol import (CircuitBreaker, CircuitOpenError,
+                                      NoNets, PermanentError, RetryPolicy,
+                                      ServerAPI, classify_error)
+from dwpa_tpu.obs import MetricsRegistry
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+PSK = b"chaos-psk-001"
+ESSID = b"ChaosNet"
+
+
+# -- fixtures --------------------------------------------------------------
+
+
+def _server(tmp_path, sub="srv"):
+    db = Database(":memory:")
+    return ServerCore(db, dictdir=str(tmp_path / sub / "dicts"),
+                      capdir=str(tmp_path / sub / "caps"))
+
+
+def _add_dict(core, words, name="chaos.txt.gz"):
+    os.makedirs(core.dictdir, exist_ok=True)
+    blob = gzip.compress(b"\n".join(words) + b"\n")
+    with open(os.path.join(core.dictdir, name), "wb") as f:
+        f.write(blob)
+    core.add_dict(f"dict/{name}", name, hashlib.md5(blob).hexdigest(),
+                  len(words), rules=None)
+
+
+def _ingest(core, lines):
+    core.add_hashlines(lines)
+    core.db.x("UPDATE nets SET algo = ''")  # release to volunteers
+
+
+def _api(app, plan=None, clock=None, **kw):
+    """Real ServerAPI over the in-process WSGI app at the ``_transport``
+    seam — classification, backoff and the breaker run for real."""
+    clock = clock if clock is not None else VirtualClock()
+    kw.setdefault("max_tries", 0)
+    kw.setdefault("backoff", 0.5)
+    kw.setdefault("rng", random.Random(11))
+    kw.setdefault("sleep", clock.sleep)
+    kw.setdefault("breaker", CircuitBreaker(threshold=3, cooldown=4.0,
+                                            clock=clock.now))
+    api = ServerAPI("http://loopback/", **kw)
+    api.retry.clock = clock.now
+    wsgi = WsgiTransport(app)
+    api._transport = wsgi if plan is None else ChaosTransport(
+        wsgi, plan, sleep=clock.sleep)
+    return api, wsgi, clock
+
+
+def _client(core, workdir, plan, clock, **cfg_kw):
+    cfg_kw.setdefault("batch_size", 64)
+    cfg_kw.setdefault("dictcount", 1)
+    cfg_kw.setdefault("device_streams", "off")
+    cfg = ClientConfig(base_url="http://loopback/", workdir=str(workdir),
+                       **cfg_kw)
+    api, wsgi, _ = _api(make_wsgi_app(core), plan=plan, clock=clock)
+    client = TpuCrackClient(cfg, api=api, log=lambda *a, **k: None)
+    return client, wsgi
+
+
+# -- FaultPlan -------------------------------------------------------------
+
+
+def test_fault_plan_same_seed_identical_schedule():
+    endpoints = ["get_work", "put_work", "download", "get_work"] * 10
+    a = FaultPlan(42, rate=0.5)
+    b = FaultPlan(42, rate=0.5)
+    for ep in endpoints:
+        a.next_fault(ep)
+        b.next_fault(ep)
+    assert a.schedule() == b.schedule()
+    assert a.kinds_injected()  # 50% over 40 calls: something fired
+    assert FaultPlan(43, rate=0.5) is not None  # different seed differs
+    c = FaultPlan(43, rate=0.5)
+    for ep in endpoints:
+        c.next_fault(ep)
+    assert c.schedule() != a.schedule()
+
+
+def test_fault_plan_force_fifo_and_validation():
+    plan = FaultPlan(0).force("get_work", "timeout").force("get_work",
+                                                           "http_5xx")
+    assert plan.next_fault("put_work") is None  # forces are per-endpoint
+    assert plan.next_fault("get_work") == "timeout"
+    assert plan.next_fault("get_work") == "http_5xx"
+    assert plan.next_fault("get_work") is None  # rate 0: nothing random
+    with pytest.raises(ValueError):
+        plan.force("get_work", "nonsense")
+
+
+# -- RetryPolicy -----------------------------------------------------------
+
+
+def test_retry_policy_deterministic_and_bounded():
+    delays_a = []
+    st = RetryPolicy(base=2.0, cap=10.0, rng=random.Random(7)).start(0)
+    for _ in range(50):
+        delays_a.append(st.next_delay())
+    st2 = RetryPolicy(base=2.0, cap=10.0, rng=random.Random(7)).start(0)
+    delays_b = [st2.next_delay() for _ in range(50)]
+    assert delays_a == delays_b  # injectable rng: exact replay
+    assert all(2.0 <= d <= 10.0 for d in delays_a)
+    assert max(delays_a) > 2.0  # jitter actually ramps off the base
+
+
+def test_retry_policy_flat_reference_parity():
+    # base == cap (the default) degenerates to the reference client's
+    # flat 123 s cadence.
+    st = RetryPolicy(base=123.0, rng=random.Random(1)).start(0)
+    assert [st.next_delay() for _ in range(5)] == [123.0] * 5
+
+
+def test_retry_policy_max_tries_and_deadline():
+    st = RetryPolicy(base=1.0, rng=random.Random(3)).start(3)
+    assert st.next_delay() is not None  # after attempt 1
+    assert st.next_delay() is not None  # after attempt 2
+    assert st.next_delay() is None      # attempt 3 was the last
+
+    clock = VirtualClock()
+    pol = RetryPolicy(base=5.0, deadline=12.0, rng=random.Random(3),
+                      clock=clock.now)
+    st = pol.start(0)
+    spent = 0.0
+    while True:
+        d = st.next_delay()
+        if d is None:
+            break
+        clock.sleep(d)
+        spent += d
+    assert spent <= 12.0  # delays are clamped into the budget
+
+
+# -- CircuitBreaker --------------------------------------------------------
+
+
+def test_circuit_breaker_lifecycle():
+    clock = VirtualClock()
+    br = CircuitBreaker(threshold=3, cooldown=10.0, clock=clock.now)
+    assert br.allow() and br.state == br.CLOSED
+    br.record_failure()
+    br.record_failure()
+    assert br.state == br.CLOSED  # below threshold
+    br.record_failure()
+    assert br.state == br.OPEN
+    assert not br.allow()
+    assert br.remaining() == pytest.approx(10.0)
+    clock.sleep(10.0)
+    assert br.allow()  # exactly one probe admitted
+    assert br.state == br.HALF_OPEN
+    br.record_success()
+    assert br.state == br.CLOSED and br.failures == 0
+    # A failed probe reopens with a fresh cooldown window.
+    for _ in range(3):
+        br.record_failure()
+    clock.sleep(10.0)
+    assert br.allow()
+    br.record_failure()
+    assert br.state == br.OPEN and br.remaining() > 0
+
+
+# -- classification --------------------------------------------------------
+
+
+def test_classify_error_table():
+    import io
+
+    http = lambda code: urllib.error.HTTPError("u", code, "m", None,
+                                               io.BytesIO(b""))
+    assert classify_error(http(404)) == ("permanent", "http_4xx")
+    assert classify_error(http(400)) == ("permanent", "http_4xx")
+    assert classify_error(http(503)) == ("transient", "http_5xx")
+    # HTTPError subclasses URLError: the 4xx row above IS the regression
+    # test for the reference bug (URLError caught first retried forever).
+    assert isinstance(http(404), urllib.error.URLError)
+    assert classify_error(TimeoutError()) == ("transient", "timeout")
+    assert classify_error(
+        urllib.error.URLError(ConnectionRefusedError())) == (
+        "transient", "refused")
+    assert classify_error(
+        urllib.error.URLError(ConnectionResetError())) == (
+        "transient", "reset")
+    assert classify_error(urllib.error.URLError("dns")) == (
+        "transient", "unreachable")
+    assert classify_error(ConnectionResetError()) == ("transient", "reset")
+    assert classify_error(OSError("disk")) == ("transient", "conn")
+
+
+# -- ChaosTransport --------------------------------------------------------
+
+
+def test_chaos_transport_kinds():
+    calls = []
+
+    def inner(url, body=None, headers=None):
+        calls.append(url)
+        return b'{"some": "body"}'
+
+    url = "http://x/?get_work=2.2.0"
+    slept = []
+    plan = FaultPlan(0)
+    t = ChaosTransport(inner, plan, sleep=slept.append, slow_s=0.25)
+
+    # Pre-exchange kinds raise WITHOUT touching the inner transport.
+    for kind, exc in (("drop", ConnectionResetError),
+                      ("timeout", TimeoutError),
+                      ("http_4xx", urllib.error.HTTPError),
+                      ("http_5xx", urllib.error.HTTPError)):
+        plan.force("get_work", kind)
+        with pytest.raises(exc):
+            t(url)
+    assert calls == []
+
+    # Post-exchange kinds complete the exchange, then corrupt the reply.
+    plan.force("get_work", "truncate")
+    assert t(url) == b'{"some": "body"}'[:8]
+    plan.force("get_work", "garbage")
+    out = t(url)
+    assert out != b'{"some": "body"}'
+    plan.force("get_work", "reject")
+    assert t(url) == b"chaos: rejected"
+    plan.force("get_work", "slow")
+    assert t(url) == b'{"some": "body"}' and slept == [0.25]
+    assert len(calls) == 4
+    assert t(url) == b'{"some": "body"}'  # no fault: clean pass-through
+
+
+# -- transport stack over the loopback server ------------------------------
+
+
+def test_http_4xx_fails_fast(tmp_path):
+    """The satellite regression: an HTTP 4xx must classify permanent and
+    raise after ONE exchange — never enter the retry loop (the reference
+    bug: HTTPError ⊂ URLError, so a 404 retried forever)."""
+    core = _server(tmp_path)
+
+    def no_sleep(_):
+        raise AssertionError("slept on a 4xx: permanent error was retried")
+
+    api, wsgi, _ = _api(make_wsgi_app(core), sleep=no_sleep)
+    with pytest.raises(PermanentError):
+        api.fetch("http://loopback/no/such/path")
+    assert len(wsgi.requests) == 1
+    assert not api.circuit_open  # a reachable server never trips it
+
+
+def test_http_5xx_retries_then_succeeds(tmp_path):
+    core = _server(tmp_path)
+    plan = FaultPlan(0).force("get_work", "http_5xx").force("get_work",
+                                                            "http_5xx")
+    api, wsgi, clock = _api(make_wsgi_app(core), plan=plan)
+    reg = MetricsRegistry()
+    api.bind_obs(reg)
+    with pytest.raises(NoNets):  # empty server: success body is "No nets"
+        api.get_work(1)
+    assert len(wsgi.requests) == 1  # only the clean third exchange landed
+    assert reg.value("dwpa_client_retries_total",
+                     endpoint="get_work", reason="http_5xx") == 2
+    assert clock.now() > 0  # backoff actually slept (on the fake clock)
+
+
+def test_get_work_garbage_goes_permanent(tmp_path):
+    core = _server(tmp_path)
+    plan = FaultPlan(0)
+    for _ in range(8):
+        plan.force("get_work", "garbage")
+    api, wsgi, _ = _api(make_wsgi_app(core), plan=plan)
+    with pytest.raises(PermanentError, match="malformed get_work"):
+        api.get_work(1)
+    # validation_retries re-fetches, then gives up: bounded exchanges.
+    assert len(wsgi.requests) == api.validation_retries + 1
+
+
+def test_circuit_opens_blocks_bounded_then_probe_recovers(tmp_path):
+    core = _server(tmp_path)
+    down = lambda url, body=None, headers=None: (_ for _ in ()).throw(
+        ConnectionRefusedError("chaos: down"))
+    api, wsgi, clock = _api(make_wsgi_app(core))
+    reg = MetricsRegistry()
+    api.bind_obs(reg)
+    live = api._transport
+    api._transport = down
+
+    # threshold=3 consecutive failures trip the breaker mid-retry; the
+    # bounded caller then fails fast instead of burning its budget.
+    with pytest.raises(CircuitOpenError):
+        api.fetch(api._endpoint("get_work=2.2.0"), max_tries=10)
+    assert api.circuit_open
+    assert reg.value("dwpa_client_circuit_state") == CircuitBreaker.OPEN
+
+    # Still inside the cooldown: fail fast again, no transport call.
+    with pytest.raises(CircuitOpenError):
+        api.fetch(api._endpoint("get_work=2.2.0"), max_tries=2)
+
+    # Past the cooldown the single probe goes through; a healthy reply
+    # closes the circuit.
+    clock.sleep(api.breaker.cooldown)
+    api._transport = live
+    with pytest.raises(NoNets):
+        api.get_work(1)
+    assert not api.circuit_open
+    assert reg.value("dwpa_client_circuit_state") == CircuitBreaker.CLOSED
+
+
+# -- degraded mode ---------------------------------------------------------
+
+
+def test_degraded_mode_cracks_buffered_units(tmp_path):
+    """With the transport down, prefetched units keep the devices busy
+    and every found lands in the outbox; the drain delivers them once
+    the server is back — nothing lost, nothing duplicated."""
+    core = _server(tmp_path)
+    psk_a, psk_b = b"chaos-psk-00A", b"chaos-psk-00B"
+    _ingest(core, [tfx.make_pmkid_line(psk_a, b"ChaosNetA", seed="dgA"),
+                   tfx.make_pmkid_line(psk_b, b"ChaosNetB", seed="dgB")])
+    _add_dict(core, [b"nope-000001", psk_a, psk_b])
+
+    # 3 forced drops: put_work attempt x2 (bounded by the outbox-backed
+    # submit), then the between-units drain probe — the third failure
+    # opens the breaker, and everything after fails fast.
+    plan = FaultPlan(5)
+    for _ in range(3):
+        plan.force("put_work", "drop")
+    clock = VirtualClock()
+    client, wsgi = _client(core, tmp_path / "w", plan, clock,
+                           prefetch_units=1, max_work_units=2)
+
+    assert client.run() == 2  # both units cracked despite the dead put path
+    assert client.api.circuit_open
+    assert client.outbox.pending_count() == 2  # one found per unit, safe
+    assert core.db.q1(
+        "SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"] == 0
+
+    # Server back (forced faults exhausted) + cooldown passed: drain.
+    clock.sleep(client.api.breaker.cooldown)
+    client._drain_outbox()
+    assert client.outbox.pending_count() == 0
+    assert not client.api.circuit_open
+    rows = core.db.q("SELECT n_state, pass FROM nets")
+    assert sorted(r["pass"] for r in rows) == [psk_a, psk_b]
+    assert all(r["n_state"] == 1 for r in rows)
+
+
+# -- the seeded soak -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_full_unit_parity(tmp_path):
+    SEED = 20260805
+    lines = [tfx.make_pmkid_line(PSK, ESSID, seed="cs1"),
+             tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="cs2")]
+    words = [b"nope-%06d" % i for i in range(60)] + [PSK]
+    RATE_KINDS = ("drop", "timeout", "http_5xx", "slow")
+
+    def build_server(sub):
+        core = _server(tmp_path, sub=sub)
+        _ingest(core, lines)
+        _add_dict(core, words)
+        return core
+
+    # Leg 1: fault-free baseline.
+    core0 = build_server("s0")
+    client0, _ = _client(core0, tmp_path / "w0", FaultPlan(SEED), VirtualClock())
+    work0 = client0.api.get_work(1)
+    res0 = client0.process_work(work0)
+    assert res0.accepted
+    founds0 = sorted(f.psk for f in res0.founds)
+    assert founds0 == [PSK, PSK]
+    state0 = sorted((r["n_state"], r["pass"])
+                    for r in core0.db.q("SELECT n_state, pass FROM nets"))
+
+    def make_plan():
+        plan = FaultPlan(SEED, rate=0.10, kinds=RATE_KINDS)
+        # Acceptance floor: at least one of each, deterministically.
+        plan.force("get_work", "timeout")
+        plan.force("get_work", "http_5xx")
+        # Body corruption goes on put_work, where the server has already
+        # processed the request — the exactly-once hazard the outbox
+        # covers.  (A torn get_work body would strand the server-side
+        # lease until reap: the re-fetch finds everything leased.)
+        plan.force("put_work", "truncate")  # server accepted, reply torn
+        plan.force("put_work", "reject")
+        return plan
+
+    # Leg 2: same servers-side state, seeded chaos schedule.
+    core1 = build_server("s1")
+    plan = make_plan()
+    clock = VirtualClock()
+    threads_before = set(threading.enumerate())
+    client1, wsgi1 = _client(core1, tmp_path / "w1", plan, clock)
+    work1 = client1.api.get_work(1)  # survives timeout, 5xx, torn body
+
+    # Mid-unit client restart: checkpoint, then a fresh process over the
+    # same workdir replays the unit instead of fetching new work.
+    client1._write_resume(work1)
+    client2, _ = _client(core1, tmp_path / "w1", plan, clock)
+    replayed = client2._read_resume()
+    assert replayed == work1
+
+    res1 = client2.process_work(replayed)
+    founds1 = sorted(f.psk for f in res1.founds)
+    assert founds1 == founds0  # no founds lost under faults
+
+    # First put_work reply was torn, the drain's hit the forced reject:
+    # the founds sit durably in the outbox until a clean exchange lands.
+    for _ in range(10):
+        if not client2.outbox.pending_count():
+            break
+        clock.sleep(client2.api.breaker.cooldown)
+        try:
+            client2._drain_outbox()
+        except ConnectionError:
+            continue
+    assert client2.outbox.pending_count() == 0
+
+    # Server-side parity with the fault-free leg: same nets cracked to
+    # the same PSK, no extra rows — repeated put_work exchanges (torn
+    # reply + redrives) never produced a duplicate accepted submission.
+    state1 = sorted((r["n_state"], r["pass"])
+                    for r in core1.db.q("SELECT n_state, pass FROM nets"))
+    assert state1 == state0
+    assert core1.db.q1("SELECT COUNT(*) c FROM nets")["c"] == len(lines)
+    # The processed unit's lease is consumed exactly like the clean leg.
+    assert core1.db.q1("SELECT COUNT(*) c FROM n2d WHERE hkey = ?",
+                       (replayed["hkey"],))["c"] == 0
+    # Resume cleared on both legs.
+    assert not os.path.exists(client0.resume_path)
+    assert not os.path.exists(client2.resume_path)
+
+    # Every required fault kind actually fired.
+    assert {"timeout", "http_5xx", "truncate",
+            "reject"} <= plan.kinds_injected()
+
+    # Same seed -> bit-identical fault schedule over the same calls.
+    replay = make_plan()
+    for _, endpoint, _ in plan.schedule():
+        replay.next_fault(endpoint)
+    assert replay.schedule() == plan.schedule()
+
+    # Clean teardown: nothing the run spawned is still alive.
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        spawned = [t for t in set(threading.enumerate()) - threads_before
+                   if t.is_alive()]
+        if not spawned:
+            break
+        for t in spawned:
+            t.join(timeout=0.5)
+    assert not spawned, f"threads leaked: {spawned}"

@@ -224,6 +224,23 @@ def test_metrics_after_one_work_unit(server, tmp_path):
     # no resume, no recompile-counter surprises recorded as gauges
     assert reg.value("dwpa_client_resume_skipped_total") is None
 
+    # resilience telemetry (ISSUE-10): the retry/backoff/circuit/outbox
+    # families are registered up front — present in the scrape even on a
+    # fault-free run — the circuit rests CLOSED, and the unit's found
+    # flowed through the outbox (journaled before put_work, then acked)
+    from dwpa_tpu.client.protocol import CircuitBreaker
+
+    assert reg.value("dwpa_client_circuit_state") == CircuitBreaker.CLOSED
+    assert reg.value("dwpa_outbox_pending_total") == 1
+    assert reg.value("dwpa_outbox_acked_total") == 1
+    assert client.outbox.pending_count() == 0
+    assert reg.series("dwpa_client_retries_total") == {}  # clean transport
+    scrape = reg.render_prometheus()
+    for fam in ("dwpa_client_retries_total", "dwpa_client_backoff_seconds",
+                "dwpa_client_circuit_state", "dwpa_outbox_pending_total",
+                "dwpa_outbox_acked_total"):
+        assert fam in scrape, fam
+
     # spans: the work_unit span parents pass1/pass2/dict_download/
     # put_work, and every child interval nests inside it
     recs = client.tracer.records()
@@ -366,6 +383,47 @@ def test_potfile_fsync_per_found(server, tmp_path, monkeypatch):
     assert len(synced) == 2
     pot = open(client.potfile).read()
     assert pot.count("fsyncpsk1") == 2
+
+
+def test_outbox_exactly_once_after_kill_before_put_work(server, tmp_path):
+    """Kill between crack and put_work (ISSUE-10): the found is journaled
+    in the outbox before the first submission attempt, a restarted client
+    delivers it exactly once, and a resume-replay re-crack of the same
+    unit never double-submits the acked key."""
+    _ingest(server, [tfx.make_pmkid_line(PSK, ESSID, seed="xo1")])
+    _add_dict(server, [PSK])
+    client = _client(server, tmp_path)
+    work = client.api.get_work(1)
+
+    def killed(hkey, cand, max_tries=None):
+        raise ConnectionError("killed between crack and put_work")
+
+    client.api.put_work = killed
+    res = client.process_work(work)
+    assert not res.accepted and [f.psk for f in res.founds] == [PSK]
+    assert client.outbox.pending_count() == 1  # journaled, not lost
+    assert server.db.q1(
+        "SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"] == 0
+
+    # "restart": a fresh client over the same workdir replays the journal
+    # and the startup drain delivers the found exactly once.
+    revived = _client(server, tmp_path)
+    assert revived.outbox.pending_count() == 1
+    revived._drain_outbox()
+    assert revived.outbox.pending_count() == 0
+    rows = server.db.q("SELECT n_state, pass FROM nets")
+    assert [(r["n_state"], r["pass"]) for r in rows] == [(1, PSK)]
+
+    # The resume file survived the crash too: replaying the unit
+    # re-cracks the same PSK, but record() drops the acked key so the
+    # server never sees a second submission.
+    puts = []
+    real_put = revived.api.put_work
+    revived.api.put_work = lambda hkey, cand, max_tries=None: (
+        puts.append(list(cand)) or real_put(hkey, cand, max_tries=max_tries))
+    res2 = revived.process_work(dict(work))
+    assert res2.accepted
+    assert puts == []  # all founds already acked: no put_work at all
 
 
 def test_shard_word_blocks_covers_stream_in_lockstep():

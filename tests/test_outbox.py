@@ -1,0 +1,191 @@
+"""Unit tests for the durable found outbox (dwpa_tpu/client/outbox.py).
+
+The journal is the durability point between crack and server ack, so
+every promise it makes — reopen fidelity, torn-tail tolerance, ack
+idempotence, replay dedup, drain ordering — gets its own test, with the
+corruption states produced by the chaos fs-fault injector rather than
+hand-rolled byte surgery.
+"""
+
+import os
+
+from dwpa_tpu.chaos import FsFaultInjector, flip_byte, tear_tail
+from dwpa_tpu.client.outbox import (FILE_MAGIC, JOURNAL_NAME, FoundOutbox,
+                                    _frame, _walk_frames)
+from dwpa_tpu.obs import MetricsRegistry
+
+
+def _cand(k, v):
+    return {"k": k, "v": v}
+
+
+def test_roundtrip_and_reopen(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    sent = box.record("hk1", [_cand("aa", "70736b31"), _cand("bb", "70736b32")])
+    assert [c["k"] for c in sent] == ["aa", "bb"]
+    assert box.pending_count() == 2
+    box.close()
+
+    # Reopen: pending founds survive verbatim, in journaled order.
+    box2 = FoundOutbox(str(tmp_path))
+    assert box2.pending() == {
+        "hk1": [_cand("aa", "70736b31"), _cand("bb", "70736b32")]}
+    box2.close()
+
+
+def test_journal_created_lazily(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    assert not os.path.exists(box.path)  # nothing cracked, nothing written
+    box.record("hk", [_cand("aa", "01")])
+    assert open(box.path, "rb").read().startswith(FILE_MAGIC)
+    box.close()
+
+
+def test_ack_idempotent_and_persistent(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    cand = [_cand("aa", "01")]
+    box.record("hk", cand)
+    box.ack("hk", cand)
+    size_after_first = os.path.getsize(box.path)
+    box.ack("hk", cand)  # second ack must not grow the journal
+    assert os.path.getsize(box.path) == size_after_first
+    assert box.pending_count() == 0
+    box.close()
+
+    # After reopen the acked key is remembered: record() drops it so the
+    # server never sees the same found twice.
+    box2 = FoundOutbox(str(tmp_path))
+    assert box2.record("hk", cand) == []
+    assert box2.pending_count() == 0
+    box2.close()
+
+
+def test_replay_dedups_latest_value_wins(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    box.record("hk", [_cand("aa", "01")])
+    box.record("hk", [_cand("aa", "02")])  # re-crack, new value
+    box.close()
+    box2 = FoundOutbox(str(tmp_path))
+    assert box2.pending() == {"hk": [_cand("aa", "02")]}
+    box2.close()
+
+
+def test_torn_tail_skipped_not_fatal(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    box.record("hk", [_cand("aa", "01"), _cand("bb", "02")])
+    box.ack("hk", [_cand("bb", "02")])
+    box.close()
+
+    # Power loss mid-append of the ack frame: the ack is gone, so "bb"
+    # correctly reverts to pending (an un-durable ack never happened).
+    tear_tail(box.path, 5)
+    box2 = FoundOutbox(str(tmp_path))
+    assert box2.pending_count() == 2
+    assert box2.pending()["hk"][0] == _cand("aa", "01")
+    box2.close()
+
+
+def test_crc_flip_truncates_at_bad_frame(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    box.record("hk", [_cand("aa", "01")])
+    box.record("hk", [_cand("bb", "02")])
+    box.close()
+
+    # Flip a byte inside the LAST frame: the first frame still replays,
+    # the corrupt one is dropped — skip, not fatal.
+    flip_byte(box.path, -3)
+    box2 = FoundOutbox(str(tmp_path))
+    assert box2.pending() == {"hk": [_cand("aa", "01")]}
+    # The compacted journal is clean again: append + reopen both work.
+    box2.record("hk", [_cand("cc", "03")])
+    box2.close()
+    box3 = FoundOutbox(str(tmp_path))
+    assert box3.pending_count() == 2
+    box3.close()
+
+
+def test_seeded_fs_fault_sweep_never_fatal(tmp_path):
+    """Any torn tail the injector produces must reopen cleanly — the
+    journal's core promise, swept over seeded corruption states."""
+    for seed in range(8):
+        d = tmp_path / f"s{seed}"
+        box = FoundOutbox(str(d))
+        for i in range(4):
+            box.record(f"hk{i}", [_cand(f"k{i}", f"{i:02x}")])
+        box.close()
+        inj = FsFaultInjector(seed)
+        inj.tear(box.path, max_bytes=48)
+        box2 = FoundOutbox(str(d))  # must not raise
+        assert box2.pending_count() <= 4
+        box2.close()
+        assert inj.log and inj.log[0][0] == "tear"
+
+
+def test_unrecognizable_journal_preserved(tmp_path):
+    p = tmp_path / JOURNAL_NAME
+    p.write_bytes(b"this is not an outbox journal")
+    box = FoundOutbox(str(tmp_path))
+    assert box.pending_count() == 0
+    assert (tmp_path / (JOURNAL_NAME + ".corrupt")).read_bytes().startswith(
+        b"this is")
+    box.close()
+
+
+def test_drain_ordering_and_partial_failure(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    box.record("hk1", [_cand("aa", "01")])
+    box.record("hk2", [_cand("bb", "02")])
+    box.record("hk3", [_cand("cc", "03")])
+
+    calls = []
+
+    def put_work(hkey, cand):
+        calls.append(hkey)
+        if hkey == "hk2":
+            return False  # server rejected: stays pending, drain continues
+        return True
+
+    delivered = box.drain(put_work)
+    assert calls == ["hk1", "hk2", "hk3"]  # journaled order
+    assert delivered == 2
+    assert box.pending() == {"hk2": [_cand("bb", "02")]}
+
+    # Transport failure stops the whole drain (server is down).
+    def put_down(hkey, cand):
+        calls.append("down")
+        raise ConnectionError("refused")
+
+    assert box.drain(put_down) == 0
+    assert calls[-1] == "down" and calls.count("down") == 1
+    assert box.pending_count() == 1
+    box.close()
+
+
+def test_compaction_bounds_journal(tmp_path):
+    box = FoundOutbox(str(tmp_path))
+    for i in range(20):
+        box.record("hk", [_cand("aa", f"{i:02x}")])  # 20 frames, 1 live key
+    box.close()
+    grown = os.path.getsize(box.path)
+    box2 = FoundOutbox(str(tmp_path))  # frames >> live: compacts on open
+    assert os.path.getsize(box2.path) < grown
+    assert box2.pending() == {"hk": [_cand("aa", "13")]}  # latest value
+    box2.close()
+
+
+def test_metrics_counters(tmp_path):
+    reg = MetricsRegistry()
+    box = FoundOutbox(str(tmp_path), registry=reg)
+    box.record("hk", [_cand("aa", "01"), _cand("bb", "02")])
+    box.ack("hk", [_cand("aa", "01")])
+    assert reg.value("dwpa_outbox_pending_total") == 2
+    assert reg.value("dwpa_outbox_acked_total") == 1
+    box.close()
+
+
+def test_frame_walker_rejects_bad_magic(tmp_path):
+    blob = FILE_MAGIC + _frame({"op": "found", "hkey": "h", "k": "a",
+                                "v": "01"}) + b"XXXX" + _frame(
+        {"op": "found", "hkey": "h", "k": "b", "v": "02"})
+    recs = [r for r, _ in _walk_frames(blob)]
+    assert [r["k"] for r in recs] == ["a"]  # stops at the bad magic
