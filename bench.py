@@ -697,6 +697,97 @@ def bench_device_streams(batch: int = None, batches: int = 12) -> dict:
             "recompiles_warm": comp.count}
 
 
+def bench_mesh_aggregate(batch: int = None, n_flush: int = 4) -> dict:
+    """bench:mesh_aggregate — the mesh-aggregate candidate pipeline
+    acceptance measurement (on-device rule expansion as pass 2).
+
+    Three legs over the SAME base-word stream and rule set:
+
+    1. host-feed flat — the pre-mesh-aggregate regime: every (word,
+       rule) pair interpreted on the host CPU, the EXPANDED candidates
+       packed and shipped (H2D bytes x n_rules), cracked lockstep;
+    2. lockstep rules — ``crack_rules_blocks`` on the full mesh: base
+       blocks ship compact, expansion is on-device, but every block
+       splits 1/ndev with a psum hits-gate barriering the mesh;
+    3. mesh aggregate — ``crack_rules_streams``: each device pulls
+       whole base blocks from the shared queue and expands rules
+       directly ahead of its own PBKDF2 dispatch, no cross-device
+       traffic at all.
+
+    Founds must be identical across all three; the compile sentinel
+    wraps the warm streams leg at 0.  ``aggregate_speedup`` is leg 2 /
+    leg 3 and ``host_expand_ratio`` is leg 1 / leg 3 (how much the
+    compact base feed buys over shipping expanded candidates).
+    """
+    from dwpa_tpu.feed import frame_blocks
+    from dwpa_tpu.rules import parse_rules
+
+    batch = batch or (131072 if ON_TPU else 2048)
+    devices = list(jax.devices())
+    rules = parse_rules([":", "u", "c", "$1", "^w", "t", "T0", "$1 $2 $3"])
+    base = [b"meshagg%07d" % i for i in range(batch * n_flush)]
+    # Planted PSK = LAST base word through the LAST rule, so the find
+    # cannot shrink the counted work on any leg.
+    psk = rules[-1].apply(base[-1])
+    lines = [T.make_pmkid_line(psk, b"bench-essid", seed="meshagg")]
+    n = len(base) * len(rules)
+
+    def expanded():
+        for w in base:
+            for r in rules:
+                out = r.apply(w)
+                if out is not None:
+                    yield out
+
+    # Warm every shape outside the timed regions: the host-feed crack
+    # step, the lockstep rules step, and each stream's single-device
+    # rules step (junk words so no engine prunes).
+    warm = [b"meshwarm%06d" % i for i in range(batch)]
+    M22000Engine(lines, batch_size=batch).crack(list(warm))
+    M22000Engine(lines, batch_size=batch).crack_rules(
+        list(warm), [rules[0], rules[-1]])
+    M22000Engine(lines, batch_size=batch).crack_rules_streams(
+        frame_blocks(iter(warm * len(devices)), batch),
+        [rules[0], rules[-1]], devices=devices)
+
+    host_eng = M22000Engine(lines, batch_size=batch)
+    with TRACER.span("bench:mesh_aggregate_hostfeed") as sp:
+        host_founds = host_eng.crack(expanded())
+    host_s = sp.seconds
+
+    lock_eng = M22000Engine(lines, batch_size=batch)
+    with TRACER.span("bench:mesh_aggregate_lockstep") as sp:
+        lock_founds = lock_eng.crack_rules_blocks(
+            frame_blocks(iter(base), batch), rules)
+    lock_s = sp.seconds
+
+    st_eng = M22000Engine(lines, batch_size=batch)
+    with watch_compiles() as comp:
+        with TRACER.span("bench:mesh_aggregate") as sp:
+            st_founds = st_eng.crack_rules_streams(
+                frame_blocks(iter(base), batch), rules, devices=devices)
+    st_s = sp.seconds
+
+    founds_identical = (
+        sorted((f.line.essid, f.psk) for f in st_founds)
+        == sorted((f.line.essid, f.psk) for f in lock_founds)
+        == sorted((f.line.essid, f.psk) for f in host_founds))
+    assert founds_identical, "mesh-aggregate legs disagree on founds"
+    assert st_founds and st_founds[0].psk == psk, "planted PSK missed"
+
+    return {"label": "mesh_aggregate", "batch": batch, "rules": len(rules),
+            "candidates": n, "streams": len(devices),
+            "hostfeed_seconds": host_s, "lockstep_seconds": lock_s,
+            "aggregate_seconds": st_s,
+            "hostfeed_pmk_per_s": n / host_s,
+            "lockstep_pmk_per_s": n / lock_s,
+            "aggregate_pmk_per_s": n / st_s,
+            "aggregate_speedup": lock_s / st_s,
+            "host_expand_ratio": host_s / st_s,
+            "founds_identical": founds_identical,
+            "recompiles_warm": comp.count}
+
+
 def bench_resilience(batch: int = None, words: int = 20_000,
                      fault_rate: float = 0.10, seed: int = 10) -> dict:
     """Crack-loop throughput under transport faults (resilient transport
@@ -928,6 +1019,7 @@ def main():
     dcache = bench_dict_cache(batch)
     small_units = bench_small_units()
     streams = bench_device_streams()
+    mesh_agg = bench_mesh_aggregate()
     overhead = bench_unit_overhead(pmkid)
     resilience = bench_resilience(batch)
 
@@ -955,6 +1047,7 @@ def main():
                     "dict_cache": _round(dcache),
                     "small_units": _round(small_units),
                     "device_streams": _round(streams),
+                    "mesh_aggregate": _round(mesh_agg),
                     "unit_overhead": _round(overhead),
                     "resilience": _round(resilience),
                 },

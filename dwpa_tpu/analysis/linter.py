@@ -113,6 +113,16 @@ Rule codes (stable — referenced by baseline.json and the docs):
   the injected ``api.sleep`` so chaos runs drive a virtual clock and
   the degraded-mode crack loop can never be parked on a hidden
   blocking sleep (``time.perf_counter`` and friends stay fine).
+- **DW113 rules-device-expansion** — the mesh-aggregate feed contract
+  (``STREAM_FILES`` plus the feed subsystem, ``FEED_DIRS``): no
+  ``apply_rules(...)`` call or import, and no ``.apply(...)`` on a
+  rule-valued receiver.  Device-eligible rules expand ON DEVICE via
+  ``build_rules_step`` out of the engine's ``_rules_flush`` seam; a
+  host interpreter call on a stream or feed-producer thread would
+  re-serialize the expansion the mesh-aggregate path exists to remove
+  (the host ships compact base blocks, not expanded candidates).  The
+  engine's own host tail (``@``-purge rules, length-overflow pairs)
+  lives in ``models/m22000.py``, outside this scope by design.
 
 The linter is repo-native, not general-purpose: rules are scoped to the
 paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
@@ -197,6 +207,9 @@ SYNC_MARKERS = {
     "block_until_ready", "asarray", "item", "array",
     "crack", "crack_batch", "crack_rules", "crack_mask", "crack_blocks",
     "crack_fused", "crack_streams", "run_blocks",
+    # rules device-expansion entries: both drain the collect pipeline
+    # (the hits gate) before returning, same as crack_rules
+    "crack_rules_blocks", "crack_rules_streams",
 }
 
 #: files holding per-device stream executors DW110 polices — a stream
@@ -211,6 +224,11 @@ STREAM_COLLECTIVES = {
 #: dispatch/pull loops (the only allowed sync is the engine's own
 #: hits-gate inside ``_collect``)
 STREAM_BLOCKING_FETCHES = {"device_get", "block_until_ready"}
+
+#: receiver names DW113 treats as rule-valued (so ``rule.apply(w)`` /
+#: ``rr.apply(...)`` flag while ``df.apply(...)``/``pool.apply(...)``
+#: stay clean); the rules-feed scope is STREAM_FILES + FEED_DIRS
+_RULE_RECV = re.compile(r"(?i)(rule|^rr?$)")
 
 #: files whose [W, 16] row-buffer allocations DW109 polices — the
 #: fused/mixed batch packers that feed per-lane rows to pmk_kernel
@@ -1050,6 +1068,51 @@ def _check_client_transport(tree, path, src_lines, out):
                     _line(src_lines, node)))
 
 
+def _check_rules_device_expansion(tree, path, src_lines, out):
+    """DW113: no host rule interpretation on the mesh-aggregate feed
+    path (``STREAM_FILES`` + ``FEED_DIRS``).
+
+    (a) any ``apply_rules(...)`` call or ``apply_rules`` import — the
+    host expansion loop re-serializes exactly the work the device
+    ``build_rules_step`` path exists to absorb; (b) ``.apply(...)`` on
+    a rule-valued receiver (``rule``/``rr``/``*_rule`` names) — a
+    single-rule interpreter call is the same hazard one word at a time.
+    Purge/overflow fallbacks belong to the engine's ``_rules_flush``
+    host tail (``models/m22000.py``), not to streams or feed
+    producers."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if any(a.name == "apply_rules" for a in node.names):
+                out.append(Violation(
+                    "DW113", path, node.lineno,
+                    "apply_rules imported on the mesh-aggregate feed "
+                    "path — streams and feed producers ship compact "
+                    "base-word blocks; rule expansion runs on device "
+                    "via the engine's _rules_flush seam",
+                    _line(src_lines, node)))
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name == "apply_rules":
+                out.append(Violation(
+                    "DW113", path, node.lineno,
+                    "host apply_rules() on the mesh-aggregate feed path "
+                    "— device-eligible rules expand on device "
+                    "(build_rules_step); host interpretation here "
+                    "re-serializes the expansion and re-inflates H2D "
+                    "bytes by the rule count",
+                    _line(src_lines, node)))
+            elif (name == "apply" and isinstance(node.func, ast.Attribute)
+                  and _RULE_RECV.search(_recv_name(node.func))):
+                out.append(Violation(
+                    "DW113", path, node.lineno,
+                    f"rule interpreter .apply() on "
+                    f"'{_recv_name(node.func)}' in stream/feed-producer "
+                    "code — per-word host mangling belongs to the "
+                    "engine's purge/overflow tail (models/m22000.py), "
+                    "never to the feed path",
+                    _line(src_lines, node)))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1086,6 +1149,9 @@ def lint_source(src: str, path: str) -> list:
         _check_fused_pad_widths(tree, path, src_lines, out)
     if path in STREAM_FILES:
         _check_stream_discipline(tree, path, src_lines, out)
+    if (path in STREAM_FILES
+            or path.startswith(tuple(d + "/" for d in FEED_DIRS))):
+        _check_rules_device_expansion(tree, path, src_lines, out)
     if path.startswith(CLIENT_DIR) and path != CLIENT_TRANSPORT_FILE:
         _check_client_transport(tree, path, src_lines, out)
     return out

@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 import jax
 
 from ..analysis import watch_compiles
-from ..feed import CandidateFeed, DictFeedSource
+from ..feed import CandidateFeed, DictFeedSource, RulesFeedSource
 from ..feed.framing import frame_blocks
 from ..gen import DictStream, psk_candidates
 from ..models import hashline as hl
@@ -488,11 +488,24 @@ class TpuCrackClient:
         if jax.process_count() == 1:
             # Pass 2 runs through the fused device-rules step now; warm
             # both interpreter step buckets so a first unit carrying
-            # server rules doesn't stall on the fused-step compile.
+            # server rules doesn't stall on the fused-step compile —
+            # through the SAME blocks/streams entry the real pass-2
+            # takes, so streams mode warms the 1-device rules step on
+            # every chip, not the full-mesh shape it will never run.
+            from ..feed.framing import frame_blocks
             from ..rules import parse_rules
 
-            eng.crack_rules([b"warm-%08d" % i for i in range(n)],
-                            parse_rules([":", "c $1 $2"]))
+            wrules = parse_rules([":", "c $1 $2"])
+            wblocks = frame_blocks(
+                (b"warm-%08d" % i for i in range(n)), n)
+            if self._use_streams():
+                eng.crack_rules_streams(wblocks, wrules,
+                                        registry=self.registry,
+                                        tracer=self.tracer)
+            else:
+                eng.crack_rules_blocks(wblocks, wrules,
+                                       registry=self.registry,
+                                       tracer=self.tracer)
         # crack_batch/crack_rules sync internally (hits gate), so the
         # span's clock stops after real device completion
         sp.stop()
@@ -927,16 +940,16 @@ class TpuCrackClient:
             with self.tracer.span("pass2") as sp2:
                 paths = self._fetch_pass2_paths(work)
                 words = (w for p in paths for w in DictStream(p))
-                if rules:
-                    # Single- AND multi-process: crack_rules takes the
-                    # full global dict stream (every host downloads whole
-                    # dicts anyway) and shards internally — each host
-                    # uploads only its 1/nproc row slice and decodes
-                    # finds from the replicated bitmask, so no host ever
-                    # feeds expanded candidates.  The feed supplies the
-                    # base words (``words()`` flat view): dict read +
-                    # gunzip move to the producer threads while
-                    # crack_rules owns framing, packing and skip.
+                if rules and jax.process_count() > 1:
+                    # Multi-process: crack_rules takes the full global
+                    # dict stream (every host downloads whole dicts
+                    # anyway) and shards internally — each host uploads
+                    # only its 1/nproc row slice and decodes finds from
+                    # the replicated bitmask, so no host ever feeds
+                    # expanded candidates.  The feed supplies the base
+                    # words (``words()`` flat view): dict read + gunzip
+                    # move to the producer threads while crack_rules
+                    # owns framing, packing and skip.
                     feed2 = CandidateFeed(
                         words, nproc=1, pid=0, prepack=None, name="pass2",
                         batch_size=self.cfg.batch_size * jax.process_count(),
@@ -944,6 +957,38 @@ class TpuCrackClient:
                     try:
                         engine.crack_rules(feed2.words(), rules,
                                            on_batch=on_batch, skip=skip2)
+                    finally:
+                        feed2.close()
+                elif rules:
+                    # Single-process mesh-aggregate pass 2: the feed
+                    # serves compact BASE-WORD blocks (warm ``.rbase``
+                    # entries skip the split + pack; cold dicts stream
+                    # once and write the entry back) and every device
+                    # expands rules on itself directly ahead of its own
+                    # PBKDF2 dispatch — ÷rule-count H2D bytes, zero host
+                    # expansion CPU in steady state, `@`-purge and
+                    # overflow pairs still host-interpreted by the seam.
+                    # The expansion stream is bit-identical to
+                    # crack_rules' (blocks framed at batch_size), so
+                    # skip2 and the checkpoint counts carry over.
+                    src = RulesFeedSource(
+                        [(p, self._dict_key(p)) for p in paths],
+                        batch_size=self.cfg.batch_size,
+                        cache=self.dict_cache, name="pass2", log=self.log)
+                    feed2 = CandidateFeed(
+                        None, batch_size=self.cfg.batch_size, frames=src,
+                        prepack=None, name="pass2", **cfg_feed)
+                    try:
+                        if self._use_streams():
+                            engine.crack_rules_streams(
+                                feed2, rules, on_batch=on_batch,
+                                skip=skip2, registry=self.registry,
+                                tracer=self.tracer)
+                        else:
+                            engine.crack_rules_blocks(
+                                feed2, rules, on_batch=on_batch,
+                                skip=skip2, registry=self.registry,
+                                tracer=self.tracer)
                     finally:
                         feed2.close()
                 else:

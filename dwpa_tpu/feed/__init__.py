@@ -13,11 +13,15 @@ calls for:
   producer threads running the host stages (dict streaming, rule
   expansion, ``$HEX`` decode + native packing), with backpressure,
   fault-with-offset delivery, and ``dwpa_feed_*`` telemetry; and
-  ``DictFeedSource`` — the warm/cold dict adapter for
-  ``CandidateFeed(frames=...)``;
+  ``DictFeedSource`` / ``RulesFeedSource`` — the warm/cold dict
+  adapters for ``CandidateFeed(frames=...)`` (candidate blocks for
+  pass 1; compact base-word blocks for the on-device rule-expansion
+  pass 2);
 - :mod:`.dictcache` — ``DictCache``: the persistent packed-dictionary
   cache (CRC-framed chunks keyed by dhash, O(1) ``(offset, count)``
-  seek, byte-capped LRU eviction) the warm path serves from;
+  seek, byte-capped LRU eviction) the warm path serves from — two
+  species per dict: ``.dcache`` (decoded candidate rows) and
+  ``.rbase`` (rule-expansion base blocks, split + pack memoized);
 - :mod:`.staging` — ``DeviceStager``: double-buffered ``shard_candidates``
   H2D, enqueueing block N+1's upload while block N's steps execute.
 
@@ -27,13 +31,14 @@ Consumed by ``M22000Engine.crack_blocks`` and wired through the client
 """
 
 from .dictcache import DictCache
-from .framing import Block, PackedSlices, frame_blocks, frame_packed, \
-    skip_stream
-from .pipeline import CandidateFeed, DictFeedSource, FeedError
+from .framing import Block, PackedSlices, RulesPrep, frame_blocks, \
+    frame_packed, frame_rules_packed, skip_stream
+from .pipeline import CandidateFeed, DictFeedSource, FeedError, \
+    RulesFeedSource
 from .staging import DeviceStager
 
 __all__ = [
-    "Block", "PackedSlices", "frame_blocks", "frame_packed", "skip_stream",
-    "CandidateFeed", "DictFeedSource", "FeedError", "DeviceStager",
-    "DictCache",
+    "Block", "PackedSlices", "RulesPrep", "frame_blocks", "frame_packed",
+    "frame_rules_packed", "skip_stream", "CandidateFeed", "DictFeedSource",
+    "FeedError", "RulesFeedSource", "DeviceStager", "DictCache",
 ]

@@ -64,6 +64,14 @@ import numpy as np
 from ..utils.fsio import fsync_dir
 
 MAGIC = b"DWDCCH1\n"
+#: the rules-base species (``<dhash>.rbase``): same framing, but the
+#: payload memoizes the DEVICE RULE-EXPANSION split — raw base lengths
+#: (no ``$HEX`` decode, no 8..63 filter: rules can shrink/grow any
+#: base) with ``0xFF`` marking host-fallback words, packed rows for the
+#: eligible bases only, and the fallback words verbatim — so a warm
+#: rules unit skips both the split and the pack (``feed.framing
+#: .RulesPrep`` / ``M22000Engine._rules_flush``)
+RBASE_MAGIC = b"DWRBCH1\n"
 FRAME_MAGIC = b"DCTF"
 END_MAGIC = b"DCTE"
 FRAME_HEADER = len(FRAME_MAGIC) + 8   # magic + payload_len u32 + crc32 u32
@@ -196,6 +204,9 @@ class DictCacheWriter:
     mismatch abandons the entry.
     """
 
+    #: file magic — the rules-base subclass swaps in its own species
+    _MAGIC = MAGIC
+
     def __init__(self, cache, dhash: str, final_path: str):
         self._cache = cache
         self._final = final_path
@@ -206,7 +217,7 @@ class DictCacheWriter:
         self.failed = False
         self.committed = False
         self._f = open(self._tmp, "wb")
-        self._f.write(MAGIC + bytes.fromhex(dhash))
+        self._f.write(self._MAGIC + bytes.fromhex(dhash))
 
     def add_many(self, words):
         """Buffer a batch of post-DictStream words (order = stream
@@ -298,6 +309,166 @@ class DictCacheWriter:
     _fail = abort
 
 
+class CachedRulesBase:
+    """One complete, mmap-backed rules-base entry — the warm read side
+    of the device rule-expansion feed (``RBASE_MAGIC`` species).
+
+    Chunk payload: ``word_offset u64 | nwords u32 | nplain u32 | marks
+    uint8[nwords] | pad-to-4 | rows u32 LE [nplain * 16] | fallback
+    blob`` where ``marks[i]`` is the raw base length of word ``offset +
+    i`` (eligible for device expansion) or ``0xFF`` (host-fallback
+    word: > 63 bytes or a ``HEX[`` carrier), rows pack the eligible
+    bases only, and the blob is ``len u32 LE | bytes`` per fallback
+    word in stream order, zero-padded to 4.  END totals are
+    ``(total_words, total_plain)``.
+    """
+
+    __slots__ = ("_mm", "_base", "_nwords", "_nplain", "_marks_off",
+                 "_rows_off", "_fb_off", "_fb_end", "total_words",
+                 "total_plain", "nbytes")
+
+    def __init__(self, mm, base, nwords, nplain, marks_off, rows_off,
+                 fb_off, fb_end, total_words, total_plain):
+        self._mm = mm
+        self._base = base
+        self._nwords = nwords
+        self._nplain = nplain
+        self._marks_off = marks_off
+        self._rows_off = rows_off
+        self._fb_off = fb_off
+        self._fb_end = fb_end
+        self.total_words = total_words
+        self.total_plain = total_plain
+        self.nbytes = len(mm)
+
+    @classmethod
+    def _load(cls, mm, dhash: str):
+        """Frame-walk; None on ANY structural doubt (miss semantics of
+        ``CachedDict._load``)."""
+        if len(mm) < HEADER or mm[:len(RBASE_MAGIC)] != RBASE_MAGIC:
+            return None
+        if mm[len(RBASE_MAGIC):HEADER] != bytes.fromhex(dhash):
+            return None
+        buf = memoryview(mm)
+        pos, off_expect, plain_total = HEADER, 0, 0
+        base, nwords, nplain = [], [], []
+        marks_off, rows_off, fb_off, fb_end = [], [], [], []
+        totals = None
+        while pos + FRAME_HEADER <= len(mm):
+            magic = bytes(buf[pos:pos + 4])
+            plen, crc = struct.unpack_from("<II", buf, pos + 4)
+            start, end = pos + FRAME_HEADER, pos + FRAME_HEADER + plen
+            if magic not in (FRAME_MAGIC, END_MAGIC) or end > len(mm):
+                break
+            if zlib.crc32(buf[start:end]) & 0xFFFFFFFF != crc:
+                break
+            if magic == END_MAGIC:
+                if plen == 16:
+                    totals = struct.unpack_from("<QQ", buf, start)
+                break
+            if plen < 16:
+                break
+            o, nw, npl = struct.unpack_from("<QII", buf, start)
+            rows_at = start + 16 + nw + (-nw % 4)
+            if o != off_expect or npl > nw or rows_at + 64 * npl > end:
+                break
+            base.append(o)
+            nwords.append(nw)
+            nplain.append(npl)
+            marks_off.append(start + 16)
+            rows_off.append(rows_at)
+            fb_off.append(rows_at + 64 * npl)
+            fb_end.append(end)
+            off_expect = o + nw
+            plain_total += npl
+            pos = end
+        if totals is None or totals != (off_expect, plain_total):
+            return None
+        return cls(mm, base, nwords, nplain, marks_off, rows_off,
+                   fb_off, fb_end, off_expect, plain_total)
+
+    def _fallback(self, k) -> list:
+        """Decode chunk ``k``'s fallback words from the blob."""
+        nfb = self._nwords[k] - self._nplain[k]
+        out, p, end = [], self._fb_off[k], self._fb_end[k]
+        for _ in range(nfb):
+            if p + 4 > end:
+                raise ValueError("rbase fallback blob truncated")
+            (n,) = struct.unpack_from("<I", self._mm, p)
+            p += 4
+            if p + n > end:
+                raise ValueError("rbase fallback blob truncated")
+            out.append(self._mm[p:p + n])
+            p += n
+        return out
+
+    def chunks(self, start: int = 0):
+        """Yield ``(chunk_word_offset, marks uint8[nwords],
+        rows u32[nplain, 16], fallback list)`` from the chunk containing
+        word index ``start`` onward — marks/rows zero-copy, fallback
+        decoded per served chunk (feed-producer work, DW111)."""
+        i = max(0, bisect.bisect_right(self._base, start) - 1)
+        for k in range(i, len(self._base)):
+            nw, npl = self._nwords[k], self._nplain[k]
+            marks = np.frombuffer(self._mm, np.uint8, nw, self._marks_off[k])
+            rows = np.frombuffer(self._mm, "<u4", npl * 16,
+                                 self._rows_off[k]).reshape(npl, 16)
+            yield self._base[k], marks, rows, self._fallback(k)
+
+    def close(self):
+        """Eager unmap (tests only; see ``CachedDict.close``)."""
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+
+
+class RulesBaseWriter(DictCacheWriter):
+    """Append-side of one dict's ``.rbase`` entry, fed by the rules
+    feed's cold tee.  Same never-raises / cross-checked / atomic-commit
+    contract as ``DictCacheWriter``; only the per-chunk payload
+    differs (split + pack of the DEVICE-ELIGIBLE bases, fallback words
+    verbatim)."""
+
+    _MAGIC = RBASE_MAGIC
+
+    def _flush(self, words):
+        from ..native import pack_candidates_fast
+
+        marks = np.empty(len(words), np.uint8)
+        plain, fb = [], []
+        for i, w in enumerate(words):
+            # MUST match M22000Engine._rules_flush's split (framing
+            # .rules_base_eligible): raw length, no $HEX decode
+            if len(w) > _MAX_LEN or b"HEX[" in w:
+                marks[i] = 0xFF
+                fb.append(w)
+            else:
+                marks[i] = len(w)
+                plain.append(w)
+        rows_b = b""
+        if plain:
+            fast = pack_candidates_fast(plain, 0, _MAX_LEN,
+                                        capacity=len(plain))
+            if fast is None:
+                raise RuntimeError("native packer unavailable")
+            rows, plens, nvalid = fast
+            # cross-check: the cache must reproduce the cold seam's
+            # pack EXACTLY, or it must not exist
+            if (nvalid != len(plain)
+                    or not np.array_equal(
+                        np.asarray(plens[:nvalid], np.uint8),
+                        marks[marks != 0xFF])):
+                raise RuntimeError("packer/lens-model disagreement")
+            rows_b = rows[:nvalid].astype("<u4", copy=False).tobytes()
+        blob = b"".join(struct.pack("<I", len(w)) + w for w in fb)
+        payload = (struct.pack("<QII", self._off, len(words), len(plain))
+                   + marks.tobytes() + b"\x00" * (-len(words) % 4)
+                   + rows_b + blob + b"\x00" * (-len(blob) % 4))
+        self._frame(FRAME_MAGIC, payload)
+        self._off += len(words)
+        self._nvalid += len(plain)
+
+
 class DictCache:
     """Directory of per-dict packed cache files under a byte cap.
 
@@ -340,21 +511,19 @@ class DictCache:
         self.m_words_cold = rate.labels(feed="cold")
         self._m_bytes.set(float(self._bytes_used()))
 
-    def _path(self, dhash: str) -> str:
-        return os.path.join(self.root, dhash + ".dcache")
+    def _path(self, dhash: str, ext: str = ".dcache") -> str:
+        return os.path.join(self.root, dhash + ext)
 
-    def reader(self, dhash: str):
-        """Open a complete cache entry for ``dhash``; None on any kind
-        of miss.  Bumps the entry's mtime (LRU input for eviction)."""
+    def _open(self, dhash: str, ext: str, loader):
         if not dhash or not _DHASH_RE.fullmatch(dhash):
             return None
-        path = self._path(dhash)
+        path = self._path(dhash, ext)
         try:
             with open(path, "rb") as f:
                 mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         except (OSError, ValueError):
             return None
-        cd = CachedDict._load(mm, dhash)
+        cd = loader(mm, dhash)
         if cd is None:
             mm.close()
             return None
@@ -364,19 +533,38 @@ class DictCache:
             pass
         return cd
 
+    def reader(self, dhash: str):
+        """Open a complete cache entry for ``dhash``; None on any kind
+        of miss.  Bumps the entry's mtime (LRU input for eviction)."""
+        return self._open(dhash, ".dcache", CachedDict._load)
+
+    def reader_rules(self, dhash: str):
+        """Open a complete rules-base (``.rbase``) entry for ``dhash``;
+        same miss/mtime semantics as ``reader``."""
+        return self._open(dhash, ".rbase", CachedRulesBase._load)
+
+    def _writer(self, dhash: str, ext: str, rd, cls):
+        if not self._native_ok or not dhash or not _DHASH_RE.fullmatch(dhash):
+            return None
+        if rd is not None:
+            return None          # complete entry: nothing to rewrite
+        try:
+            return cls(self, dhash, self._path(dhash, ext))
+        except OSError:
+            return None
+
     def writer(self, dhash: str):
         """Start (re)writing ``dhash``'s entry; None when a complete
         entry already exists, the key is malformed, or the native
         packer is unavailable."""
-        if not self._native_ok or not dhash or not _DHASH_RE.fullmatch(dhash):
-            return None
-        rd = self.reader(dhash)
-        if rd is not None:
-            return None          # complete entry: nothing to rewrite
-        try:
-            return DictCacheWriter(self, dhash, self._path(dhash))
-        except OSError:
-            return None
+        return self._writer(dhash, ".dcache", self.reader(dhash),
+                            DictCacheWriter)
+
+    def writer_rules(self, dhash: str):
+        """Start (re)writing ``dhash``'s rules-base entry; same
+        preconditions as ``writer``."""
+        return self._writer(dhash, ".rbase", self.reader_rules(dhash),
+                            RulesBaseWriter)
 
     # -- size accounting / eviction ----------------------------------------
 
@@ -387,7 +575,7 @@ class DictCache:
         except OSError:
             return out
         for name in names:
-            if not name.endswith(".dcache"):
+            if not name.endswith((".dcache", ".rbase")):
                 continue
             path = os.path.join(self.root, name)
             try:

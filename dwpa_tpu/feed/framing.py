@@ -153,6 +153,103 @@ class PackedSlices:
         return packed, lens, r
 
 
+class RulesPrep:
+    """Warm base-word block for the device rule-expansion seam
+    (``M22000Engine._rules_flush``): the split rule sets, the expanded
+    split into device-eligible bases vs host-fallback words, and the
+    native pack already ran (and were cached) — the seam skips straight
+    to the H2D upload.  ``rows``/``lens`` are the packed device layout
+    of the ``nplain`` eligible bases in stream order (rows exactly what
+    ``pack_candidates_fast(plain, 0, MAX_PSK_LEN)`` produces, lens the
+    RAW byte lengths — rule semantics see the undecoded word);
+    ``fallback`` is the block's ineligible words (> 63 bytes or
+    ``HEX[`` carriers), also in stream order, routed to the host
+    interpreter for every rule.  The ``rules_base`` class attribute is
+    the marker the seam duck-types on.
+    """
+
+    __slots__ = ("rows", "lens", "nplain", "fallback")
+
+    rules_base = True
+
+    def __init__(self, rows, lens, nplain, fallback):
+        self.rows = rows
+        self.lens = lens
+        self.nplain = nplain
+        self.fallback = fallback
+
+    def padded_rows(self, cap: int):
+        """Rows zero-padded to the engine's ``cap`` — the warm twin of
+        the seam's cold ``pack_candidates_fast(..., capacity=cap)``
+        call (always a fresh native-endian array: the stored rows may
+        be a read-only little-endian mmap view)."""
+        out = np.zeros((cap, 16), np.uint32)
+        out[:self.nplain] = self.rows[:self.nplain]
+        return out
+
+
+def rules_base_eligible(w: bytes) -> bool:
+    """The device-expansion split predicate (must match
+    ``M22000Engine._rules_flush``): overlong bases and anything that
+    could put ``$HEX[...]`` syntax in front of the engine's unhex stage
+    go to the host interpreter."""
+    return len(w) <= 63 and b"HEX[" not in w
+
+
+def frame_rules_packed(chunks, total: int, batch_size: int,
+                       base_offset: int = 0, start: int = 0):
+    """Frame a warm rules-base cache range into ``Block``s — the
+    ``.rbase`` twin of ``frame_packed``: identical ``(offset, count)``
+    geometry to ``frame_blocks`` over the same raw word stream
+    (single-process framing; multi-host rules attacks keep the flat
+    ``crack_rules`` path), with ``Block.prep`` carrying an eager
+    ``RulesPrep`` instead of words.
+
+    ``chunks`` yields ``(chunk_word_offset, marks uint8[nwords],
+    rows u32[nplain, 16], fallback list)`` views
+    (``dictcache.CachedRulesBase.chunks(start)``); ``marks[i]`` is the
+    base length of word ``offset + i`` or ``0xFF`` for a fallback
+    word.  ``start``/``base_offset`` follow ``frame_packed``.
+    """
+    it = iter(chunks)
+    cur = None     # (chunk base, marks, rows, fb, plain-cumsum, fb-cumsum)
+    pos = start
+    while pos < total:
+        c = min(batch_size, total - pos)
+        lo, hi = pos, pos + c
+        lens_parts, rows_parts, fbs = [], [], []
+        a = lo
+        while a < hi:
+            while cur is None or cur[0] + len(cur[1]) <= a:
+                cbase, marks, rows, fb = next(it)
+                cur = (cbase, marks, rows, fb,
+                       np.cumsum(marks != 0xFF), np.cumsum(marks == 0xFF))
+            cbase, marks, rows, fb, pcum, fcum = cur
+            b = min(hi, cbase + len(marks))
+            i, j = a - cbase, b - cbase
+            ps = int(pcum[i - 1]) if i else 0
+            pe = int(pcum[j - 1]) if j else 0
+            fs = int(fcum[i - 1]) if i else 0
+            fe = int(fcum[j - 1]) if j else 0
+            m = marks[i:j]
+            lens_parts.append(m[m != 0xFF])
+            rows_parts.append(rows[ps:pe])
+            fbs.extend(fb[fs:fe])
+            a = b
+        lens = (np.concatenate(lens_parts) if lens_parts
+                else np.zeros(0, np.uint8))
+        nplain = len(lens)
+        packed = np.zeros((nplain, 16), np.uint32)
+        r = 0
+        for rp in rows_parts:
+            packed[r:r + len(rp)] = rp
+            r += len(rp)
+        yield Block(offset=base_offset + (pos - start), count=c, words=[],
+                    prep=RulesPrep(packed, lens, nplain, fbs),
+                    padded=(c == 0))
+        pos += c
+
+
 def frame_packed(chunks, total: int, batch_size: int, nproc: int = 1,
                  pid: int = 0, base_offset: int = 0, start: int = 0):
     """Frame a warm packed-dict word range into ``Block``s — the

@@ -50,7 +50,9 @@ import time
 import jax
 
 from ..obs import SpanTracer, default_registry, get_logger
-from .framing import frame_blocks, frame_packed, skip_stream
+from .framing import (
+    frame_blocks, frame_packed, frame_rules_packed, skip_stream,
+)
 
 
 class FeedError(RuntimeError):
@@ -466,6 +468,119 @@ class DictFeedSource:
             finally:
                 if wr is not None:
                     wr.abort()    # no-op after the tee's commit
+            el = time.perf_counter() - t0
+            if cache is not None and served and el > 0:
+                cache.m_words_cold.set(served / el)
+
+
+class RulesFeedSource:
+    """Framed BASE-WORD block source for the device rule-expansion
+    path (``M22000Engine.crack_rules_blocks`` /
+    ``crack_rules_streams``) — warm where the ``.rbase`` cache has the
+    dict, cold (with ``.rbase`` write-back) where not.
+
+    The rules twin of ``DictFeedSource``.  Warm dicts serve
+    ``feed.framing.RulesPrep`` blocks (split + pack memoized; the
+    engine seam skips straight to H2D), cold dicts serve raw word
+    blocks while the tee writes the entry for next time.  Both sides
+    emit the identical ``frame_blocks`` ``(offset, count)`` geometry
+    over the same raw word stream, so a mid-pass warm/cold transition
+    (or a resume across one) cannot shift the expansion order.
+
+    ``skip`` counts BASE WORDS (not expanded pairs): the engine's
+    expanded resume window is handled by its own skip argument; this
+    source-level skip exists for whole-dict fast-forwarding, and warm
+    dicts satisfy it with an index seek.  Single-process framing only —
+    multi-host rules attacks keep the flat ``crack_rules`` path
+    (``CandidateFeed.words``).
+    """
+
+    def __init__(self, units, batch_size: int, *, cache=None,
+                 skip: int = 0, name: str = "pass2", log=None):
+        self.units = list(units)
+        self.batch_size = int(batch_size)
+        self.cache = cache
+        self.name = name
+        self.skipped = 0
+        self._skip = max(0, int(skip))
+        self._log = log or get_logger("feed").info
+
+    def _tee(self, stream, wr):
+        buf = []
+        for w in stream:
+            buf.append(w)
+            if len(buf) >= _TEE_WORDS:
+                wr.add_many(buf)
+                buf = []
+            yield w
+        wr.add_many(buf)
+        wr.commit()
+
+    def __iter__(self):
+        cache = self.cache
+        offset = 0
+        remaining = self._skip
+        warned = False
+        for path, dhash in self.units:
+            rd = cache.reader_rules(dhash) if cache is not None else None
+            if rd is not None:
+                # -- warm: mmap'd pre-split base blocks ------------------
+                total = rd.total_words
+                if remaining >= total:
+                    remaining -= total
+                    self.skipped += total
+                    offset += total
+                    continue
+                start = remaining
+                self.skipped += start
+                remaining = 0
+                t0 = time.perf_counter()
+                served = 0
+                for blk in frame_rules_packed(rd.chunks(start), total,
+                                              self.batch_size,
+                                              base_offset=offset + start,
+                                              start=start):
+                    cache.m_hit_blocks.inc()
+                    served += blk.count
+                    yield blk
+                el = time.perf_counter() - t0
+                if served and el > 0:
+                    cache.m_words_warm.set(served / el)
+                offset += total
+                continue
+            # -- cold: gunzip stream; write the rules base alongside ----
+            from ..gen.dicts import DictStream
+
+            stream = iter(DictStream(path))
+            if remaining:
+                if remaining > SKIP_REPLAY_WARN and not warned:
+                    warned = True
+                    self._log(
+                        f"feed {self.name}: cold dict skip replays "
+                        f"{remaining} words (O(skip) gzip prefix; a warm "
+                        f"rules-base cache would seek the block index "
+                        f"instead)")
+                k = skip_stream(stream, remaining)
+                self.skipped += k
+                offset += k
+                remaining -= k
+                if remaining:
+                    continue
+            wr = cache.writer_rules(dhash) if cache is not None else None
+            src = stream if wr is None else self._tee(stream, wr)
+            t0 = time.perf_counter()
+            served = 0
+            try:
+                for blk in frame_blocks(src, self.batch_size,
+                                        base_offset=offset):
+                    if cache is not None:
+                        cache.m_miss_blocks.inc()
+                    served += blk.count
+                    offset = blk.offset + blk.count
+                    yield blk
+            finally:
+                if wr is not None:
+                    wr.abort()
             el = time.perf_counter() - t0
             if cache is not None and served and el > 0:
                 cache.m_words_cold.set(served / el)

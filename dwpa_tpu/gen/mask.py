@@ -69,6 +69,49 @@ def mask_words(mask: str, custom: dict = None, skip: int = 0, limit: int = None)
         yield bytes(word)
 
 
+class MaskPrep:
+    """Block prep marking a keyspace slice for ON-DEVICE generation.
+
+    The mask analog of ``feed.framing.RulesPrep``: a ``Block`` carrying
+    one of these owns the keyspace range ``[start, start + count)`` and
+    materializes NO host-side bytes — ``M22000Engine._prepare_block``
+    recognizes the ``mask_gen`` marker and runs ``device_mask_words``
+    under its own mesh sharding (lockstep full-mesh engines and
+    per-device stream engines each generate exactly their own shard).
+    This puts mask work behind the same framed-block interface as dict
+    and rules feeds: ``crack_blocks``/``crack_streams`` schedule it
+    with no new dispatch regime.
+    """
+
+    __slots__ = ("mask", "custom", "start")
+
+    mask_gen = True
+
+    def __init__(self, mask: str, custom: dict, start: int):
+        self.mask = mask
+        self.custom = custom
+        self.start = start
+
+
+def mask_blocks(mask: str, batch_size: int, skip: int = 0,
+                limit: int = None, custom: dict = None):
+    """Frame a mask keyspace slice into feed ``Block``s of ``MaskPrep``
+    — same ``(offset, count)`` geometry as ``mask_words`` consumed
+    through ``frame_blocks``, zero candidate bytes.  ``offset`` is the
+    ABSOLUTE keyspace index (hashcat ``-s`` coordinates), so resume
+    checkpoints interop with ``crack_mask(skip=...)``."""
+    from ..feed.framing import Block
+
+    total = mask_keyspace(mask, custom)
+    end = total if limit is None else min(total, skip + limit)
+    pos = skip
+    while pos < end:
+        n = min(batch_size, end - pos)
+        yield Block(offset=pos, count=n, words=[],
+                    prep=MaskPrep(mask, custom, pos))
+        pos += n
+
+
 def mask_digits_at(mask: str, idx: int, custom: dict = None):
     """Mixed-radix digit vector (last position fastest) for keyspace
     index ``idx`` — the host-side seed for the on-device generator

@@ -432,6 +432,148 @@ class _ShiftedWords:
         return self.words[i] if 0 <= i < len(self.words) else None
 
 
+class _BaseWords:
+    """Lazy base-word list over packed rows + lengths (the warm rules
+    cache keeps bases in packed device layout; the fallback split
+    guarantees they are HEX-free, so a packed row round-trips
+    losslessly).  Supports ``len``/indexing/iteration like the raw word
+    list it replaces, materializing bytes only on demand."""
+
+    __slots__ = ("rows", "lens", "n")
+
+    def __init__(self, rows, lens, n):
+        self.rows = rows
+        self.lens = lens
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, b):
+        if not 0 <= b < self.n:
+            raise IndexError(b)
+        return bo.words_to_bytes_be(self.rows[b])[: int(self.lens[b])]
+
+
+class _MaskWords:
+    """pws stand-in for on-device mask generation: index -> word bytes,
+    computed on demand from the keyspace position.
+
+    Indexed by GLOBAL batch column (a pure function of the keyspace
+    index) — on a multi-process mesh every host can materialize any
+    column, so the find decode skips the candidate exchange (see
+    ``_gather_find_data``)."""
+
+    __slots__ = ("mask", "custom", "start")
+
+    global_cols = True
+
+    def __init__(self, mask, custom, start):
+        self.mask = mask
+        self.custom = custom
+        self.start = start
+
+    def __getitem__(self, b):
+        from ..gen.mask import mask_words
+
+        return next(mask_words(self.mask, self.custom,
+                               skip=self.start + b, limit=1))
+
+
+class _RulesCtx:
+    """Shared per-attack context for the device-expansion seam
+    (``M22000Engine._rules_flush``): the split rule sets, the expanded
+    stream's rule count, and the attack's telemetry.  One ctx serves
+    every rules dispatch path — serial ``crack_rules``, block-framed
+    ``crack_rules_blocks`` and the per-device stream adapter — so the
+    fallback routing, resume accounting and metrics cannot drift
+    between executors."""
+
+    def __init__(self, rules, registry=None, tracer=None):
+        from ..obs.metrics import default_registry
+        from ..obs.spans import SpanTracer, default_tracer
+        from ..rules.device import device_supported, encode_rule
+
+        self.rules = list(rules)
+        self.dev_rules = [(r, encode_rule(r)) for r in self.rules
+                          if device_supported(r)]
+        self.host_rules = [r for r in self.rules
+                           if not device_supported(r)]
+        self.n_rules = len(self.rules)
+        reg = registry if registry is not None else default_registry()
+        if tracer is None:
+            tracer = default_tracer() if registry is None \
+                else SpanTracer(registry)
+        self.tracer = tracer
+        self.m_device = reg.counter(
+            "dwpa_rules_device_expanded_total",
+            "(word, rule) pairs expanded on device by the rules seam")
+        fb = reg.counter(
+            "dwpa_rules_host_fallback_total",
+            "(word, rule) pairs routed to the host rule interpreter, "
+            "by reason (purge = unsupported op, overflow = length/HEX)")
+        self.m_purge = fb.labels(reason="purge")
+        self.m_overflow = fb.labels(reason="overflow")
+
+    def span(self, name: str):
+        return self.tracer.span(name)
+
+
+class _BlockAgg:
+    """Demux per-sub-batch pipeline events back into per-BLOCK reports.
+
+    A rules block expands into several dispatched sub-batches (fused
+    rule chunks + the host-expanded tail); ``_Pipeline`` fires its
+    callback once per sub-batch, in stream order, but block callers
+    (``crack_rules_blocks`` and the client resume checkpoint behind it)
+    need exactly one ``on_batch(consumed, founds)`` per base block.
+    ``begin``/``emit``/``close`` bracket each block's emissions;
+    ``record`` (installed as the pipeline callback) attributes every
+    event to the oldest incompletely-fired block — emission order IS
+    event order because the pipeline is FIFO — and a block fires once
+    closed and fully collected.  A block that emitted nothing (wholly
+    inside the resume prefix, or nothing dispatchable) reports
+    nothing, matching ``crack_rules``'s skip semantics."""
+
+    def __init__(self, on_batch):
+        import collections
+
+        self.on_batch = on_batch
+        self.blocks = collections.deque()
+        self.cur = None
+
+    def begin(self):
+        self.cur = {"emitted": 0, "fired": 0, "got": 0,
+                    "founds": [], "closed": False}
+        self.blocks.append(self.cur)
+
+    def emit(self):
+        self.cur["emitted"] += 1
+
+    def record(self, raw, new):
+        for b in self.blocks:
+            if b["fired"] < b["emitted"]:
+                b["fired"] += 1
+                b["got"] += raw
+                b["founds"].extend(new)
+                break
+        self._fire()
+
+    def close(self):
+        self.cur["closed"] = True
+        self.cur = None
+        self._fire()
+
+    def _fire(self):
+        while self.blocks:
+            b = self.blocks[0]
+            if not b["closed"] or b["fired"] < b["emitted"]:
+                return
+            self.blocks.popleft()
+            if b["emitted"] and self.on_batch is not None:
+                self.on_batch(b["got"], b["founds"])
+
+
 class _Pipeline:
     """Shared dispatch/sync pipeline for the engine's crack paths.
 
@@ -736,6 +878,25 @@ class M22000Engine:
         prep = getattr(block, "prep", None)
         if prep is None:
             return self._prepare(block.words)
+        if hasattr(prep, "mask_gen"):
+            # on-device mask generation (gen.mask.MaskPrep): no host
+            # bytes at all — generate the block's keyspace slice
+            # directly under this engine's mesh sharding (a 1-device
+            # stream engine generates exactly its own candidates)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..gen.mask import device_mask_words
+            from ..parallel.mesh import DP_AXIS
+
+            n = block.count
+            gen = -(-n // self.mesh.size) * self.mesh.size
+            t0 = time.perf_counter()
+            pw_words = device_mask_words(
+                prep.mask, prep.start, gen, prep.custom,
+                sharding=NamedSharding(self.mesh, P(DP_AXIS, None)),
+            )
+            self.stage_times["prepare"] += time.perf_counter() - t0
+            return _MaskWords(prep.mask, prep.custom, prep.start), n, pw_words
         if hasattr(prep, "materialize"):
             # a lazy dict-cache prep (framing.PackedSlices) normally
             # materializes on the feed's producer threads; blocks
@@ -1516,7 +1677,185 @@ class M22000Engine:
         self.stage_times["collect"] += time.perf_counter() - t0
         return founds
 
-    def crack_rules(self, words, rules, on_batch=None, skip: int = 0) -> list:
+    def _rules_flush(self, ctx, batch, account, gbatch, nproc, pid,
+                     push, skip):
+        """One base-word flush through the device-expansion seam.
+
+        The shared body of every rules dispatch path (serial
+        ``crack_rules``, block-framed ``crack_rules_blocks``, and the
+        per-device stream adapter ``_RulesStreamEngine``): split the
+        batch into device-eligible bases vs host-fallback words, plan
+        the fused rule chunks (``simulate_lens`` overflow routing +
+        per-chunk resume accounting), pack and upload the base block
+        ONCE, dispatch every chunk, then host-expand the fallback tail
+        through the normal packed path.  ``batch`` is either a raw word
+        list or a warm ``feed.framing.RulesPrep`` (pre-split,
+        pre-packed bases — the dict cache's base-block layout), in
+        which case both the split and the pack are skipped.
+        ``push(record, report)`` / ``skip(report)`` receive the
+        dispatched sub-batches in stream order; ``account(consumed)``
+        owns the caller's resume window.
+        """
+        from ..native import pack_candidates_fast
+        from ..parallel import shard_candidates
+        from ..parallel.mesh import shard_vector
+        from ..parallel.step import RULES_CHUNK
+        from ..rules.device import simulate_lens, stack_rules
+
+        rules = ctx.rules
+        dev_rules, host_rules = ctx.dev_rules, ctx.host_rules
+        base_dev = lens_dev = None
+        cap = 0
+        with ctx.span("rules:expand"):
+            if hasattr(batch, "rules_base"):
+                # Warm base block: the fallback split and the pack
+                # already ran (and were cached); bases stay in packed
+                # device layout, words materialize lazily on hits.
+                pre = batch
+                nplain = pre.nplain
+                plain = _BaseWords(pre.rows, pre.lens, nplain)
+                lens_np = np.asarray(pre.lens[:nplain], dtype=np.int32)
+                fallback = [(w, None) for w in pre.fallback]
+            else:
+                plain, fallback = [], []
+                for w in batch:
+                    # Host-fallback words: overlong bases, and anything
+                    # that could put "$HEX[...]" syntax in front of the
+                    # engine's unhex stage (the host paths unhex AFTER
+                    # rule application, so the device must not hash such
+                    # words literally).  The substring check also catches
+                    # bases a rule could extend into a valid wrapper;
+                    # synthesizing "HEX[" itself from unrelated
+                    # characters via chained inserts remains a
+                    # documented, pathological divergence.
+                    if len(w) > MAX_PSK_LEN or b"HEX[" in w:
+                        fallback.append((w, None))  # None = every rule
+                    else:
+                        plain.append(w)
+                pre = None
+                nplain = len(plain)
+                lens_np = None
+            plan = []  # (chunk, expanded pairs, candidates to report)
+            if nplain and self.groups and dev_rules:
+                # Per-chunk accounting and host-overflow routing run
+                # BEFORE any device work: a resume window covering the
+                # whole batch must not pay the H2D upload, and the
+                # overflow pairs belong to the host tail regardless.
+                # ``consumed`` excludes the overflow pairs deferred to
+                # the host tail — each candidate is counted exactly
+                # once, or skip-by-count resume would overshoot.
+                if lens_np is None:
+                    lens_np = np.asarray([len(w) for w in plain], np.int32)
+                for c0 in range(0, len(dev_rules), RULES_CHUNK):
+                    chunk = dev_rules[c0:c0 + RULES_CHUNK]
+                    overflow = 0
+                    for rule, _steps in chunk:
+                        _, hostneed = simulate_lens(rule, lens_np)
+                        if hostneed.any():
+                            pairs = [(plain[i], rule)
+                                     for i in np.flatnonzero(hostneed)]
+                            fallback.extend(pairs)
+                            overflow += len(pairs)
+                    expanded = nplain * len(chunk) - overflow
+                    plan.append((chunk, expanded, account(expanded)))
+            if any(rep for _, _, rep in plan):
+                t0 = time.perf_counter()
+                # Pad to the engine batch size like _prepare: a distinct
+                # cap per partial batch would mean a fresh multi-second
+                # XLA compile of the fused step per distinct count.
+                cap = max(gbatch,
+                          -(-nplain // self.mesh.size) * self.mesh.size)
+                if pre is not None:
+                    rows = pre.padded_rows(cap)
+                else:
+                    packed = pack_candidates_fast(plain, 0, MAX_PSK_LEN, cap)
+                    if packed is None:  # no native lib: plain Python pack
+                        rows = np.zeros((cap, 16), np.uint32)
+                        rows[:nplain] = bo.pack_passwords_be(plain)
+                    else:
+                        rows, _, n = packed  # lens_np above is the source
+                        assert n == nplain  # min_len=0: no compaction
+                lens_pad = np.zeros(cap, np.int32)
+                lens_pad[:nplain] = lens_np
+                # Every host packed the identical global batch; ship only
+                # this host's row slice (shard_* assemble the global
+                # array from per-process slices on a multi-process mesh).
+                lo, hi = pid * (cap // nproc), (pid + 1) * (cap // nproc)
+                base_dev = shard_candidates(self.mesh, rows[lo:hi])
+                lens_dev = shard_vector(self.mesh, lens_pad[lo:hi])
+                self.stage_times["prepare"] += time.perf_counter() - t0
+        if base_dev is not None:
+            # Chunked fused dispatch: each chunk of RULES_CHUNK rules
+            # runs expand+PBKDF2+verify in ONE device call per group
+            # with ONE hits-gate (through the tunnel every dispatch
+            # costs ~0.1 s fixed — per-rule dispatch would throttle
+            # the attack; see parallel/step.py build_rules_step).
+            for chunk, expanded, report in plan:
+                if not self.groups:
+                    break
+                if report == 0:
+                    continue  # chunk wholly inside the resume prefix
+                stack = stack_rules([s for _, s in chunk], RULES_CHUNK)
+                pws = [_RuleWords(plain, r) for r, _ in chunk]
+                pws += [None] * (RULES_CHUNK - len(chunk))
+                t0 = time.perf_counter()
+                outs = []
+                for essid in list(self.groups):
+                    step = self._rules_step_for(essid)
+                    outs.append(
+                        (self._full[essid], step(base_dev, lens_dev, stack))
+                    )
+                self.stage_times["dispatch"] += time.perf_counter() - t0
+                ctx.m_device.inc(expanded)
+                push((pws, nplain, outs, cap // self.mesh.size), report)
+        # Host-expanded tail: unsupported rules over plain words,
+        # plus the per-(word, rule) fallbacks collected above.
+        # ``consumed`` counts attempted (word, rule) pairs — rejects
+        # included, mirroring how the device chunks count them.
+        out = []
+        pairs_pending = 0
+
+        def submit_host(cands, consumed):
+            report = account(consumed)
+            if report == 0:
+                return  # batch wholly inside the resume prefix
+            if nproc > 1:
+                # The tail stream is the identical global expansion
+                # on every host; each host dispatches its contiguous
+                # 1/nproc block (an empty block still dispatches
+                # padding via _prepare, keeping SPMD lockstep).
+                blk = -(-len(cands) // nproc)
+                cands = cands[pid * blk:(pid + 1) * blk]
+            prep = self._prepare(cands)
+            if prep is not None and self.groups:
+                push(self._dispatch(prep), report)
+            else:
+                skip(report)
+
+        def tail(w, rr):
+            nonlocal out, pairs_pending
+            pairs_pending += 1
+            o = rr.apply(w)
+            if o is not None:
+                out.append(o)
+                if len(out) >= gbatch:
+                    submit_host(out, pairs_pending)
+                    out, pairs_pending = [], 0
+
+        for w, r in fallback:
+            ctx.m_overflow.inc(len(rules) if r is None else 1)
+            for rr in (rules if r is None else [r]):
+                tail(w, rr)
+        if host_rules and nplain:
+            ctx.m_purge.inc(nplain * len(host_rules))
+            for w in plain:
+                for rr in host_rules:
+                    tail(w, rr)
+        if out or pairs_pending:
+            submit_host(out, pairs_pending)
+
+    def crack_rules(self, words, rules, on_batch=None, skip: int = 0, *,
+                    registry=None, tracer=None) -> list:
         """Rules attack with ON-DEVICE mangling (rules/device.py).
 
         The host uploads each base batch ONCE (packed + lengths) and
@@ -1565,20 +1904,12 @@ class M22000Engine:
         candidates never exist host-side, so it cannot islice() them
         the way pass 1 does (help_crack.py:737-763 restart contract).
         """
-        from ..parallel import shard_candidates
-        from ..parallel.mesh import shard_vector
-        from ..parallel.step import RULES_CHUNK
-        from ..rules.device import (
-            device_supported, encode_rule, simulate_lens, stack_rules,
-        )
-
         nproc = jax.process_count()
         pid = jax.process_index()
         #: global words per flush: each host uploads a batch_size slice
         gbatch = self.batch_size * nproc
 
-        dev_rules = [(r, encode_rule(r)) for r in rules if device_supported(r)]
-        host_rules = [r for r in rules if not device_supported(r)]
+        ctx = _RulesCtx(rules, registry=registry, tracer=tracer)
         pipe = _Pipeline(self, on_batch)
         skip_left = int(skip)
 
@@ -1592,135 +1923,8 @@ class M22000Engine:
             return consumed - take
 
         def flush(batch):
-            from ..native import pack_candidates_fast
-
-            plain, fallback = [], []
-            for w in batch:
-                # Host-fallback words: overlong bases, and anything that
-                # could put "$HEX[...]" syntax in front of the engine's
-                # unhex stage (the host paths unhex AFTER rule
-                # application, so the device must not hash such words
-                # literally).  The substring check also catches bases a
-                # rule could extend into a valid wrapper; synthesizing
-                # "HEX[" itself from unrelated characters via chained
-                # inserts remains a documented, pathological divergence.
-                if len(w) > MAX_PSK_LEN or b"HEX[" in w:
-                    fallback.append((w, None))  # None = every rule
-                else:
-                    plain.append(w)
-            if plain and self.groups and dev_rules:
-                # Per-chunk accounting and host-overflow routing run
-                # BEFORE any device work: a resume window covering the
-                # whole batch must not pay the H2D upload, and the
-                # overflow pairs belong to the host tail regardless.
-                # ``consumed`` excludes the overflow pairs deferred to
-                # the host tail — each candidate is counted exactly
-                # once, or skip-by-count resume would overshoot.
-                lens_np = np.asarray([len(w) for w in plain], np.int32)
-                plan = []  # (chunk, candidates to report; 0 = skip)
-                for c0 in range(0, len(dev_rules), RULES_CHUNK):
-                    chunk = dev_rules[c0:c0 + RULES_CHUNK]
-                    overflow = 0
-                    for rule, _steps in chunk:
-                        _, hostneed = simulate_lens(rule, lens_np)
-                        if hostneed.any():
-                            pairs = [(plain[i], rule)
-                                     for i in np.flatnonzero(hostneed)]
-                            fallback.extend(pairs)
-                            overflow += len(pairs)
-                    plan.append(
-                        (chunk, account(len(plain) * len(chunk) - overflow))
-                    )
-            else:
-                plan = []
-            if any(rep for _, rep in plan):
-                t0 = time.perf_counter()
-                # Pad to the engine batch size like _prepare: a distinct
-                # cap per partial batch would mean a fresh multi-second
-                # XLA compile of the fused step per distinct count.
-                cap = max(gbatch,
-                          -(-len(plain) // self.mesh.size) * self.mesh.size)
-                packed = pack_candidates_fast(plain, 0, MAX_PSK_LEN, cap)
-                if packed is None:  # no native lib: plain Python pack
-                    rows = np.zeros((cap, 16), np.uint32)
-                    rows[:len(plain)] = bo.pack_passwords_be(plain)
-                else:
-                    rows, _, n = packed  # lens_np above is the one source
-                    assert n == len(plain)  # min_len=0: no compaction
-                lens_pad = np.zeros(cap, np.int32)
-                lens_pad[:len(plain)] = lens_np
-                # Every host packed the identical global batch; ship only
-                # this host's row slice (shard_* assemble the global
-                # array from per-process slices on a multi-process mesh).
-                lo, hi = pid * (cap // nproc), (pid + 1) * (cap // nproc)
-                base_dev = shard_candidates(self.mesh, rows[lo:hi])
-                lens_dev = shard_vector(self.mesh, lens_pad[lo:hi])
-                self.stage_times["prepare"] += time.perf_counter() - t0
-                # Chunked fused dispatch: each chunk of RULES_CHUNK rules
-                # runs expand+PBKDF2+verify in ONE device call per group
-                # with ONE hits-gate (through the tunnel every dispatch
-                # costs ~0.1 s fixed — per-rule dispatch would throttle
-                # the attack; see parallel/step.py build_rules_step).
-                for chunk, report in plan:
-                    if not self.groups:
-                        break
-                    if report == 0:
-                        continue  # chunk wholly inside the resume prefix
-                    stack = stack_rules([s for _, s in chunk], RULES_CHUNK)
-                    pws = [_RuleWords(plain, r) for r, _ in chunk]
-                    pws += [None] * (RULES_CHUNK - len(chunk))
-                    t0 = time.perf_counter()
-                    outs = []
-                    for essid in list(self.groups):
-                        step = self._rules_step_for(essid)
-                        outs.append(
-                            (self._full[essid], step(base_dev, lens_dev, stack))
-                        )
-                    self.stage_times["dispatch"] += time.perf_counter() - t0
-                    pipe.push((pws, len(plain), outs, cap // self.mesh.size),
-                              report)
-            # Host-expanded tail: unsupported rules over plain words,
-            # plus the per-(word, rule) fallbacks collected above.
-            # ``consumed`` counts attempted (word, rule) pairs — rejects
-            # included, mirroring how the device chunks count them.
-            out = []
-            pairs_pending = 0
-
-            def submit_host(cands, consumed):
-                report = account(consumed)
-                if report == 0:
-                    return  # batch wholly inside the resume prefix
-                if nproc > 1:
-                    # The tail stream is the identical global expansion
-                    # on every host; each host dispatches its contiguous
-                    # 1/nproc block (an empty block still dispatches
-                    # padding via _prepare, keeping SPMD lockstep).
-                    blk = -(-len(cands) // nproc)
-                    cands = cands[pid * blk:(pid + 1) * blk]
-                prep = self._prepare(cands)
-                if prep is not None and self.groups:
-                    pipe.push(self._dispatch(prep), report)
-                else:
-                    pipe.skip(report)
-
-            def tail(w, rr):
-                nonlocal out, pairs_pending
-                pairs_pending += 1
-                o = rr.apply(w)
-                if o is not None:
-                    out.append(o)
-                    if len(out) >= gbatch:
-                        submit_host(out, pairs_pending)
-                        out, pairs_pending = [], 0
-
-            for w, r in fallback:
-                for rr in (rules if r is None else [r]):
-                    tail(w, rr)
-            for w in plain:
-                for rr in host_rules:
-                    tail(w, rr)
-            if out or pairs_pending:
-                submit_host(out, pairs_pending)
+            self._rules_flush(ctx, batch, account, gbatch, nproc, pid,
+                              pipe.push, pipe.skip)
 
         batch = []
         for w in words:
@@ -1740,6 +1944,150 @@ class M22000Engine:
         pipe.drain()
         return pipe.founds
 
+    def crack_rules_blocks(self, blocks, rules, on_batch=None,
+                           skip: int = 0, *, registry=None,
+                           tracer=None) -> list:
+        """Rules attack over a framed base-word block stream.
+
+        The block-framed twin of ``crack_rules``: the feed hands
+        ``Block``s of BASE words (cold: raw word lists; warm: the dict
+        cache's pre-packed ``RulesPrep`` base layout) and every block
+        expands on device through the shared ``_rules_flush`` seam, so
+        the serial block path, the stream path and the flat-iterable
+        path are ONE dispatch regime.  ``on_batch(consumed, founds)``
+        fires once per BLOCK in stream order, where ``consumed`` counts
+        EXPANDED (word x rule) candidates — the resume domain.  The
+        expansion stream is bit-identical to ``crack_rules`` over the
+        same words when blocks are framed at ``batch_size x
+        process_count`` words (``feed.framing.frame_blocks``), so skip
+        offsets are interchangeable between the two entry points.
+
+        ``skip`` counts expanded candidates.  A block wholly inside the
+        resume window is dropped in O(1) — its coverage is exactly
+        ``count x len(rules)`` because the seam counts every (word,
+        rule) pair exactly once (device chunks + host tail, rejects
+        included) — without packing or device work; the straddling
+        block replays at-least-once and reports only its remainder,
+        exactly like ``crack_rules``'s sub-batch accounting.
+
+        Multi-process: pass GLOBAL blocks (every host the same stream),
+        the ``crack_rules`` contract.
+        """
+        ctx = _RulesCtx(rules, registry=registry, tracer=tracer)
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        gbatch = self.batch_size * nproc
+        agg = _BlockAgg(on_batch)
+        pipe = _Pipeline(self, agg.record)
+        skip_left = int(skip)
+
+        def account(consumed: int) -> int:
+            nonlocal skip_left
+            take = min(skip_left, consumed)
+            skip_left -= take
+            return consumed - take
+
+        def push(rec, report):
+            agg.emit()
+            pipe.push(rec, report)
+
+        def skipf(report):
+            agg.emit()
+            pipe.skip(report)
+
+        for block in blocks:
+            if not self.groups and not pipe.active:
+                break
+            exp = block.count * ctx.n_rules
+            if skip_left >= exp:
+                # O(1) whole-block drop: the expanded-count invariant
+                # makes the block's total coverage count x n_rules
+                # without splitting, packing or expanding it.
+                skip_left -= exp
+                continue
+            prep = getattr(block, "prep", None)
+            batch = prep if hasattr(prep, "rules_base") else block.words
+            agg.begin()
+            self._rules_flush(ctx, batch, account, gbatch, nproc, pid,
+                              push, skipf)
+            agg.close()
+        pipe.drain()
+        return pipe.founds
+
+    def crack_rules_streams(self, blocks, rules, on_batch=None,
+                            skip: int = 0, *, devices=None, registry=None,
+                            tracer=None, engine_factory=None,
+                            max_attempts=2) -> list:
+        """Rules attack as independent per-device streams.
+
+        The stream twin of ``crack_rules_blocks`` (and the rules analog
+        of ``crack_streams``): each local device gets its own
+        single-device engine wrapped in the rules seam adapter
+        (``_RulesStreamEngine``) and pulls WHOLE base blocks from the
+        shared queue, expanding rules directly ahead of its own PBKDF2
+        dispatch — the host ships compact base blocks only (candidate
+        H2D divided by the rule count), there is no cross-device
+        candidate traffic, and a straggler or crash affects only its
+        own stream (requeue comes free from ``StreamExecutor``).
+        ``on_batch(consumed, founds)`` fires once per base block in
+        global stream order with the block's EXPANDED coverage —
+        identical framing to ``crack_rules_blocks``, so resume offsets
+        interop across all three rules entry points.  Blocks wholly
+        inside ``skip`` are dropped before they reach the queue (O(1)
+        per block); the straddler carries its in-block expanded skip
+        immutably, so a crash requeue replays it deterministically.
+
+        Single-process only (``crack_streams``'s contract).
+        ``engine_factory(device)`` overrides the per-stream INNER
+        engine (the seam adapter still wraps it) for tests/benches.
+        """
+        from ..parallel.streams import StreamExecutor
+
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "crack_rules_streams is single-process only — multi-host "
+                "slices keep the lockstep crack_rules path")
+        ctx = _RulesCtx(rules, registry=registry, tracer=tracer)
+        if devices is None:
+            devices = list(self.mesh.devices.flat)
+        lines = [n.line for n in self.nets]
+
+        def _default_factory(device):
+            from ..parallel import default_mesh
+
+            return type(self)(
+                lines, nc=self.nc, batch_size=self.batch_size,
+                verify_with_oracle=self.verify_with_oracle,
+                mesh=default_mesh(devices=[device]),
+                pmk_store=self.pmk_store)
+
+        inner = engine_factory or _default_factory
+
+        def factory(device):
+            return _RulesStreamEngine(inner(device), ctx)
+
+        def wrapped():
+            pos, skip_left = 0, int(skip)
+            for block in blocks:
+                exp = block.count * ctx.n_rules
+                if skip_left >= exp:
+                    skip_left -= exp
+                    pos += exp
+                    continue
+                prep = getattr(block, "prep", None)
+                base = prep if hasattr(prep, "rules_base") else block.words
+                yield _RulesBlock(pos + skip_left, exp - skip_left,
+                                  base, skip_left)
+                pos += exp
+                skip_left = 0
+
+        ex = StreamExecutor(factory, devices, registry=registry,
+                            tracer=tracer, max_attempts=max_attempts)
+        founds = ex.run(wrapped(), on_batch=on_batch)
+        for f in founds:
+            self.remove(f)  # keep this (parent) engine's live view in sync
+        return founds
+
     def crack_mask(self, mask: str, skip: int = 0, limit: int = None,
                    custom: dict = None, on_batch=None) -> list:
         """Mask attack with on-device candidate generation.
@@ -1752,48 +2100,95 @@ class M22000Engine:
         materialized lazily from their keyspace index only for the rare
         hit columns.  ``skip``/``limit`` slice the keyspace exactly like
         ``gen.mask.mask_words`` (hashcat -s/-l semantics).
+
+        Since the mesh-aggregate refactor this is a thin front over
+        ``crack_blocks`` with ``gen.mask.mask_blocks``'s ``MaskPrep``
+        stream — generation happens in ``_prepare_block`` under this
+        engine's mesh sharding, so the SAME block stream also schedules
+        through ``crack_streams`` (each device stream generates its own
+        keyspace slices) or the multi-unit executor.
         """
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..gen.mask import mask_blocks
 
-        from ..gen.mask import device_mask_words, mask_keyspace, mask_words
-        from ..parallel.mesh import DP_AXIS
+        return self.crack_blocks(
+            mask_blocks(mask, self.batch_size, skip=skip, limit=limit,
+                        custom=custom),
+            on_batch=on_batch)
 
-        class _LazyWords:
-            """pws stand-in: index -> word bytes, computed on demand.
 
-            Indexed by GLOBAL batch column (a pure function of the
-            keyspace position) — on a multi-process mesh every host can
-            materialize any column, so the find decode skips the
-            candidate exchange (see _gather_find_data)."""
+class _RulesBlock:
+    """Work item for the per-device rules streams: a base-word block in
+    EXPANDED (word x rule) coordinates.
 
-            global_cols = True
+    ``offset``/``count`` frame the block's expanded remainder in the
+    global candidate stream (``StreamExecutor`` orders on_batch demux by
+    them and reports ``count`` as the consumed amount — identical to
+    ``crack_rules_blocks`` framing).  ``base`` is the raw base-word list
+    or a warm ``RulesPrep``; ``skip_pairs`` is the immutable in-block
+    expanded resume offset — immutable so a crash requeue replays the
+    straddling block deterministically on the surviving stream.
+    """
 
-            def __init__(self, start):
-                self.start = start
+    __slots__ = ("offset", "count", "base", "skip_pairs")
 
-            def __getitem__(self, b):
-                return next(mask_words(mask, custom,
-                                       skip=self.start + b, limit=1))
+    def __init__(self, offset, count, base, skip_pairs=0):
+        self.offset = offset
+        self.count = count
+        self.base = base
+        self.skip_pairs = skip_pairs
 
-        total = mask_keyspace(mask, custom)
-        end = total if limit is None else min(total, skip + limit)
-        pipe = _Pipeline(self, on_batch)  # same depth semantics as crack()
-        pos = skip
-        while pos < end and self.groups:
-            n = min(self.batch_size, end - pos)
-            # generate a full mesh-multiple; _collect masks columns
-            # past nvalid (wrap-around words never count)
-            gen = -(-n // self.mesh.size) * self.mesh.size
-            t0 = time.perf_counter()
-            # generated directly under the dp sharding: each device
-            # (across all hosts) materializes only its own candidate
-            # shard — no redistribution, no host-side bytes
-            pw_words = device_mask_words(
-                mask, pos, gen, custom,
-                sharding=NamedSharding(self.mesh, P(DP_AXIS, None)),
-            )
-            self.stage_times["prepare"] += time.perf_counter() - t0
-            pipe.push(self._dispatch((_LazyWords(pos), n, pw_words)), n)
-            pos += n
-        pipe.drain()
-        return pipe.founds
+
+class _RulesStreamEngine:
+    """Adapter giving a single-device engine the block protocol
+    ``parallel.streams.DeviceStream`` drives, with rules expansion done
+    ON this stream's device via the shared ``_rules_flush`` seam.
+
+    ``_prepare_block`` runs the whole seam for the block (split, pack,
+    per-chunk fused dispatch, host tail) and buffers the dispatched
+    records; ``_dispatch`` is the identity (device work was issued
+    during prepare — the stream still overlaps blocks because results
+    are only BLOCKED on in ``_collect``, ``PIPELINE_DEPTH`` blocks
+    later).  ``_collect`` drains the block's records in order through
+    the inner engine's normal decode path.
+    """
+
+    def __init__(self, inner, ctx):
+        self.inner = inner
+        self.ctx = ctx
+        self.PIPELINE_DEPTH = inner.PIPELINE_DEPTH
+
+    @property
+    def groups(self):
+        return self.inner.groups
+
+    @property
+    def nets(self):
+        return self.inner.nets
+
+    def remove(self, found):
+        self.inner.remove(found)
+
+    def _prepare_block(self, block):
+        eng = self.inner
+        recs = []
+        skip_left = block.skip_pairs
+
+        def account(consumed):
+            nonlocal skip_left
+            take = min(skip_left, consumed)
+            skip_left -= take
+            return consumed - take
+
+        eng._rules_flush(self.ctx, block.base, account, eng.batch_size,
+                         1, 0, lambda rec, rep: recs.append(rec),
+                         lambda rep: None)
+        return recs
+
+    def _dispatch(self, recs):
+        return recs
+
+    def _collect(self, recs):
+        founds = []
+        for rec in recs:
+            founds.extend(self.inner._collect(rec))
+        return founds

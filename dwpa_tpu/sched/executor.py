@@ -56,6 +56,12 @@ class WorkUnit:
     attempts: int = 0
     consumed: int = 0
     founds: list = field(default_factory=list)
+    #: parsed rule list for a RULES unit: ``words`` then carries BASE
+    #: words and the unit dispatches through the device rule-expansion
+    #: seam (``M22000Engine.crack_rules_blocks``/``crack_rules_streams``)
+    #: instead of ``crack_fused`` — and ``skip``/``consumed`` count
+    #: EXPANDED (word x rule) candidates, the rules resume domain
+    rules: list = None
     # -- producer/consumer internals --
     _materialized: list = None
     _essids: tuple = None
@@ -141,7 +147,10 @@ class MultiUnitExecutor:
         try:
             for u in self.units:
                 words = iter(u.words)
-                if u.skip:
+                if u.skip and u.rules is None:
+                    # a rules unit's skip is EXPANDED pairs — the
+                    # engine's O(1) block-drop applies it, not the
+                    # base-word stream
                     skip_stream(words, u.skip)  # consumes in place
                 u._materialized = list(words)
                 self._q.put(u)
@@ -199,6 +208,10 @@ class MultiUnitExecutor:
         wave, taken = [], set()
 
         def try_add(u):
+            if wave and (u.rules is not None or wave[0].rules is not None):
+                # rules units run as singleton waves: their dispatch is
+                # the device-expansion seam, not the fused salt table
+                return False
             es = u.essids()
             if any(e in taken for e in es):
                 return False
@@ -226,6 +239,8 @@ class MultiUnitExecutor:
 
     def _run_wave(self, wave, batch_size, mesh=None):
         """Crack one wave through a fresh engine's fused path."""
+        if len(wave) == 1 and wave[0].rules is not None:
+            return self._run_wave_rules(wave[0], batch_size, mesh)
         lines = [ln for u in wave for ln in u.lines]
         engine = self._make_engine(lines, batch_size, mesh)
         by_essid = {}
@@ -255,6 +270,44 @@ class MultiUnitExecutor:
         engine.crack_fused(parts, on_batch=on_batch,
                            max_units=self.fuse_max_units,
                            tracer=self.tracer, on_fused=on_fused)
+
+    def _run_wave_rules(self, u, batch_size, mesh=None):
+        """Crack one RULES unit through the shared device-expansion
+        seam — the executor's pass-2 dispatch is the same
+        ``crack_rules_blocks``/``crack_rules_streams`` entry as the
+        serial client path, not a fourth regime.  Streams engage under
+        the same conditions as ``_execute_wave`` (enabled, single
+        process, multiple local devices, mesh-capable factory);
+        otherwise the engine's own lockstep mesh runs the blocks
+        serially.  ``u.consumed`` advances in EXPANDED candidates."""
+        import jax
+
+        from ..feed.framing import frame_blocks
+
+        engine = self._make_engine(u.lines, batch_size, mesh)
+        u.consumed = u.skip
+
+        def on_batch(consumed, founds):
+            u.consumed += consumed
+            for f in founds:
+                if all(f.line is not g.line or f.psk != g.psk
+                       for g in u.founds):
+                    u.founds.append(f)
+
+        blocks = frame_blocks(iter(u._materialized),
+                              engine.batch_size * jax.process_count())
+        if (mesh is None and self._streams_enabled()
+                and self._factory_takes_mesh()
+                and jax.process_count() == 1
+                and jax.local_device_count() > 1
+                and hasattr(engine, "crack_rules_streams")):
+            engine.crack_rules_streams(
+                blocks, u.rules, on_batch=on_batch, skip=u.skip,
+                registry=self.registry, tracer=self.tracer)
+        else:
+            engine.crack_rules_blocks(
+                blocks, u.rules, on_batch=on_batch, skip=u.skip,
+                registry=self.registry, tracer=self.tracer)
 
     # -- device-stream wave scheduling (parallel/streams.py) ---------------
 

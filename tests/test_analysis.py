@@ -779,6 +779,74 @@ def test_dw112_real_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# DW113: no host rule expansion on the mesh-aggregate feed path
+# ---------------------------------------------------------------------------
+
+STREAMS_PATH = "dwpa_tpu/parallel/streams.py"
+
+
+def test_dw113_flags_apply_rules_in_streams():
+    """The seeded failure mode: a stream 'helpfully' expanding its base
+    block through the host interpreter before dispatch — exactly the
+    serialization the device-expansion seam removed."""
+    src = """
+        from ..rules import apply_rules
+
+        def _prepare_block(self, block):
+            return list(apply_rules(self.rules, iter(block.words)))
+    """
+    vs = lint(src, STREAMS_PATH)
+    assert codes(vs) == ["DW113", "DW113"]
+    assert "base-word blocks" in vs[0].detail
+    assert "build_rules_step" in vs[1].detail
+    # the engine's own host tail (models/) is outside the scope, as is
+    # arbitrary host-side code
+    assert lint(src, "dwpa_tpu/models/m22000.py") == []
+    assert lint(src, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw113_flags_rule_apply_in_feed_producer():
+    vs = lint("""
+        def _produce_expanded(rules, words):
+            for w in words:
+                for rr in rules:
+                    out = rr.apply(w)
+                    if out is not None:
+                        yield out
+    """, "dwpa_tpu/feed/pipeline.py")
+    assert codes(vs) == ["DW113"]
+    assert "purge/overflow tail" in vs[0].detail
+
+
+def test_dw113_non_rule_apply_receivers_stay_clean():
+    """.apply() on non-rule receivers (a thread pool, a dataframe) and
+    rule handling WITHOUT interpretation (splitting, packing, counting)
+    are the compliant idioms."""
+    assert lint("""
+        def _produce(pool, frame, rules):
+            pool.apply(len, (rules,))
+            frame.apply(str)
+            eligible = [r for r in rules if r.steps is not None]
+            return len(eligible)
+    """, "dwpa_tpu/feed/dictcache.py") == []
+
+
+def test_dw113_real_stream_and_feed_tree_is_clean():
+    """The shipped mesh-aggregate path obeys its own seam: streams and
+    the feed subsystem never host-interpret a rule."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    targets = [os.path.join(root, "dwpa_tpu", "parallel", "streams.py")]
+    feed_dir = os.path.join(root, "dwpa_tpu", "feed")
+    targets += [os.path.join(feed_dir, n) for n in sorted(os.listdir(feed_dir))
+                if n.endswith(".py")]
+    for path in targets:
+        assert [v for v in lint_file(path, root)
+                if v.code == "DW113"] == [], path
+
+
+# ---------------------------------------------------------------------------
 # DW109: fused-pad-width discipline
 # ---------------------------------------------------------------------------
 
@@ -1207,8 +1275,8 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
-             "DW108", "DW109", "DW111", "DW112", "DW201", "DW202", "DW203",
-             "DW204"}
+             "DW108", "DW109", "DW111", "DW112", "DW113", "DW201", "DW202",
+             "DW203", "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

@@ -360,6 +360,52 @@ def test_dictcache_metrics_and_warm_unit(server, tmp_path):
     assert reg.value("dwpa_dictcache_words_per_s", feed="warm") > 0
 
 
+def test_rules_metrics_in_loopback_unit(server, tmp_path):
+    """Mesh-aggregate feed telemetry contract (the ISSUE-11 acceptance
+    check): a rules unit surfaces the device-expansion counters — every
+    (word, rule) pair lands in EXACTLY one of
+    dwpa_rules_device_expanded_total or
+    dwpa_rules_host_fallback_total{reason="purge"|"overflow"} — and the
+    rules:expand span is traced inside pass 2."""
+    mangled = b"METRICWORD9!"  # 'metricword9!' through 'u'
+    _ingest(server, [tfx.make_pmkid_line(mangled, ESSID, seed="rm1")])
+    words = [b"metricword9!", b"metricfill-1", b"metricfill-2", b"y" * 70]
+    os.makedirs(server.dictdir, exist_ok=True)
+    blob = gzip.compress(b"\n".join(words) + b"\n")
+    open(os.path.join(server.dictdir, "rm.txt.gz"), "wb").write(blob)
+    # ':' and 'u' expand on device; '@a' purges on the host interpreter
+    server.add_dict("dict/rm.txt.gz", "rm.txt.gz",
+                    hashlib.md5(blob).hexdigest(), len(words),
+                    rules=":\nu\n@a\n")
+    reg = MetricsRegistry()
+    client = _client(server, tmp_path, registry=reg)
+
+    work = client.api.get_work(1)
+    res = client.process_work(work)
+    assert res.accepted and [f.psk for f in res.founds] == [mangled]
+
+    # 3 eligible bases x 2 device rules; 3 x 1 purge rule host-applied;
+    # the 70-byte base falls back to the host for ALL 3 rules
+    dev = reg.value("dwpa_rules_device_expanded_total")
+    purge = reg.value("dwpa_rules_host_fallback_total", reason="purge")
+    over = reg.value("dwpa_rules_host_fallback_total", reason="overflow")
+    assert dev == 3 * 2
+    assert purge == 3 * 1
+    assert over == 1 * 3
+    # conservation: the split is a partition of the expanded keyspace
+    assert dev + purge + over == len(words) * 3
+    for name in ("dwpa_rules_device_expanded_total",
+                 "dwpa_rules_host_fallback_total"):
+        assert name in reg.render_prometheus(), name
+
+    # the expansion span fires inside the pass2 interval
+    recs = client.tracer.records()
+    spans = [r for r in recs if r["name"] == "rules:expand"]
+    assert spans
+    p2 = next(r for r in recs if r["name"] == "pass2")
+    assert all(p2["t0"] <= s["t0"] <= s["t1"] <= p2["t1"] for s in spans)
+
+
 def test_potfile_fsync_per_found(server, tmp_path, monkeypatch):
     """Potfile appends are flushed AND fsynced per found: a crash right
     after put_work must not lose the only local copy of a cracked PSK
@@ -636,10 +682,12 @@ def test_archive_logs_appended(server, tmp_path):
 
 
 def test_rules_unit_runs_on_device_path(server, tmp_path, monkeypatch):
-    """Pass 2 of a rules work unit goes through engine.crack_rules (the
-    on-device rule engine — the hashcat-on-GPU analog of the reference
-    client's ``-S -r`` invocation, help_crack.py:773), NOT host
-    expansion: apply_rules must never see the pass-2 dict stream."""
+    """Pass 2 of a rules work unit goes through the device-expansion
+    seam (crack_rules_blocks / crack_rules_streams — the hashcat-on-GPU
+    analog of the reference client's ``-S -r`` invocation,
+    help_crack.py:773), NOT host expansion: apply_rules must never see
+    the pass-2 dict stream, and the legacy flat crack_rules entry is
+    reserved for multi-host slices."""
     import dwpa_tpu.client.main as cm
     from dwpa_tpu.models.m22000 import M22000Engine as Eng
     from dwpa_tpu.rules import wpa_rules_text
@@ -654,11 +702,21 @@ def test_rules_unit_runs_on_device_path(server, tmp_path, monkeypatch):
                     hashlib.md5(blob).hexdigest(), 1, rules=wpa_rules_text())
 
     calls = []
-    real = Eng.crack_rules
+    real_blocks = Eng.crack_rules_blocks
+    real_streams = Eng.crack_rules_streams
+    monkeypatch.setattr(
+        Eng, "crack_rules_blocks",
+        lambda self, *a, **k: (calls.append(k.get("skip", 0)),
+                               real_blocks(self, *a, **k))[1])
+    monkeypatch.setattr(
+        Eng, "crack_rules_streams",
+        lambda self, *a, **k: (calls.append(k.get("skip", 0)),
+                               real_streams(self, *a, **k))[1])
     monkeypatch.setattr(
         Eng, "crack_rules",
-        lambda self, *a, **k: (calls.append(k.get("skip", 0)),
-                               real(self, *a, **k))[1])
+        lambda self, *a, **k: (_ for _ in ()).throw(
+            AssertionError("single-process pass 2 must dispatch through "
+                           "the blocks/streams seam")))
     monkeypatch.setattr(
         cm, "apply_rules",
         lambda *a, **k: (_ for _ in ()).throw(

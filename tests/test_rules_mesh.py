@@ -1,0 +1,252 @@
+"""Mesh-aggregate rules pipeline (on-device expansion as pass 2).
+
+Layers under test:
+
+- DIFFERENTIAL PARITY — the three rules entry points
+  (``crack_rules`` flat, ``crack_rules_blocks`` framed,
+  ``crack_rules_streams`` per-device) against each other AND against
+  a pure host-expansion reference (``Rule.apply`` + plain ``crack``):
+  identical found sets, identical expanded-consumed totals, identical
+  per-block ``on_batch`` sequences between the framed twins;
+- RESUME — skip offsets at arbitrary (word x rule) positions — whole
+  dropped blocks plus a mid-word straddler — interop bit-identically
+  across all three entry points;
+- FAULTS — a per-device stream crashing mid-flush requeues its rules
+  block onto a survivor with found list, consumed total and demux
+  order unchanged;
+- CACHE — the ``.rbase`` base-block species: a warm replay serves
+  pre-split ``RulesPrep`` blocks whose cracks are bit-identical to the
+  cold run, including a warm index-seek resume.
+
+One ESSID only (three nets share it) and every engine on a ONE-device
+mesh (``_eng``), so the whole file compiles a single rules step — the
+stream legs' inner engines are single-device by construction, and the
+serial/flat legs reuse the same shape instead of paying a full-mesh
+compile nothing else in tier-1 shares.  ``BATCH = 32`` matches
+tests/test_streams.py so the plain single-device crack step is shared
+too.  Lockstep full-mesh rules parity is tests/test_rules_device.py's
+job.
+"""
+
+import gzip
+import hashlib
+import os
+
+import jax
+import pytest
+
+from dwpa_tpu import testing as synth
+from dwpa_tpu.feed import DictCache, RulesFeedSource, frame_blocks
+from dwpa_tpu.models.m22000 import M22000Engine
+from dwpa_tpu.parallel import default_mesh
+from dwpa_tpu.rules import parse_rules
+
+BATCH = 32
+ESSID = b"MeshNet"
+#: ':'/'u'/'c $1' expand on device; '@a' purges on the host interpreter
+RULES = [":", "u", "c $1", "@a"]
+
+PSK_U = b"MESHWORD77!"      # 'meshword77!' through 'u'  (word 5, block 0)
+PSK_C = b"Meshtwo88!1"      # 'meshtwo88!' through 'c $1' (word 70, block 2)
+
+
+def _lines():
+    """Two crackable nets + one never cracked, all one ESSID: one PBKDF2
+    group, one rules-step compile, and no early stop."""
+    return [
+        synth.make_pmkid_line(PSK_U, ESSID, seed="rm1"),
+        synth.make_pmkid_line(PSK_C, ESSID, seed="rm2"),
+        synth.make_pmkid_line(b"never-in-keyspace", ESSID, seed="rm3"),
+    ]
+
+
+def _words():
+    """3 blocks of 32.  Word 40 is overlong (host fallback for ALL
+    rules); word 41 is exactly 63 bytes, so 'c $1' overflows it into the
+    per-pair host tail while ':'/'u' keep it on device."""
+    words = [b"mshjunk%04d" % i for i in range(96)]
+    words[5] = b"meshword77!"
+    words[70] = b"meshtwo88!"
+    words[40] = b"y" * 70
+    words[41] = b"z" * 63
+    return words
+
+
+def _rules():
+    return parse_rules(RULES)
+
+
+def _eng(lines):
+    """Single-device engine: one rules-step compile for the whole file
+    (matches the stream legs' inner engines)."""
+    return M22000Engine(lines, batch_size=BATCH,
+                        mesh=default_mesh(devices=jax.devices()[:1]))
+
+
+def _keys(founds):
+    return sorted((f.line.essid, f.psk) for f in founds)
+
+
+def _host_reference(lines, words, rules):
+    """Pure host expansion — the pre-mesh-aggregate regime: interpret
+    every (word, rule) pair on the host, then a plain dict crack."""
+    cands = []
+    for w in words:
+        for r in rules:
+            out = r.apply(w)
+            if out is not None:
+                cands.append(out)
+    return _eng(lines).crack(iter(cands))
+
+
+def test_rules_differential_parity():
+    """All three device-expansion entry points equal the host-expansion
+    reference, the framed twins share an identical per-block on_batch
+    sequence, and every (word x rule) pair is consumed exactly once."""
+    lines, words, rules = _lines(), _words(), _rules()
+    exp_total = len(words) * len(rules)
+
+    ref_founds = _host_reference(lines, words, rules)
+    assert _keys(ref_founds) == [(ESSID, PSK_U), (ESSID, PSK_C)]
+
+    flat_log = []
+    flat = _eng(lines).crack_rules(
+        iter(words), rules,
+        on_batch=lambda c, f: flat_log.append(c))
+
+    blk_log = []
+    blk_eng = _eng(lines)
+    blk = blk_eng.crack_rules_blocks(
+        frame_blocks(iter(words), blk_eng.batch_size), rules,
+        on_batch=lambda c, f: blk_log.append((c, sorted(x.psk for x in f))))
+
+    st_log = []
+    st_eng = _eng(lines)
+    st = st_eng.crack_rules_streams(
+        frame_blocks(iter(words), st_eng.batch_size), rules,
+        on_batch=lambda c, f: st_log.append((c, sorted(x.psk for x in f))),
+        devices=jax.devices()[:2])
+
+    assert _keys(flat) == _keys(blk) == _keys(st) == _keys(ref_founds)
+    # per-BLOCK framing identical between the serial and stream twins
+    assert st_log == blk_log
+    assert len(blk_log) == 3
+    assert sum(c for c, _ in blk_log) == sum(flat_log) == exp_total
+    # both engines pruned their live view down to the uncracked net
+    assert len(blk_eng.nets) == len(st_eng.nets) == 1
+
+
+@pytest.mark.parametrize("skip", [22, 263])
+def test_rules_resume_skip_arbitrary_offsets(skip):
+    """skip=22 straddles word 5 mid-expansion (a (word x rule) offset
+    inside block 0); skip=263 drops blocks 0-1 whole (O(1), 256 pairs)
+    and straddles block 2.  All three entry points cover the identical
+    unskipped tail."""
+    lines, words, rules = _lines(), _words(), _rules()
+    exp_total = len(words) * len(rules)
+
+    flat = _eng(lines).crack_rules(
+        iter(words), rules, skip=skip)
+
+    blk_log = []
+    blk = _eng(lines).crack_rules_blocks(
+        frame_blocks(iter(words), BATCH), rules, skip=skip,
+        on_batch=lambda c, f: blk_log.append(c))
+
+    st_log = []
+    st = _eng(lines).crack_rules_streams(
+        frame_blocks(iter(words), BATCH), rules, skip=skip,
+        on_batch=lambda c, f: st_log.append(c),
+        devices=jax.devices()[:2])
+
+    assert _keys(flat) == _keys(blk) == _keys(st)
+    assert sum(blk_log) == sum(st_log) == exp_total - skip
+    if skip == 263:
+        # blocks 0-1 fell inside the window: PSK_U (word 5) is skipped,
+        # PSK_C (word 70, block 2) is still covered
+        assert _keys(blk) == [(ESSID, PSK_C)]
+        assert len(blk_log) == 1        # two whole blocks never framed
+    else:
+        assert _keys(blk) == [(ESSID, PSK_U), (ESSID, PSK_C)]
+
+
+def test_rules_stream_crash_requeues_block():
+    """Stream 0's first flush dies mid-wave: the rules block requeues
+    onto the survivor, and founds / consumed total / per-block demux
+    order all match a clean serial run."""
+    lines, words, rules = _lines(), _words(), _rules()
+
+    ref_log = []
+    ref = _eng(lines).crack_rules_blocks(
+        frame_blocks(iter(words), BATCH), rules,
+        on_batch=lambda c, f: ref_log.append((c, sorted(x.psk for x in f))))
+
+    booms = []
+
+    def factory(device):
+        eng = M22000Engine(lines, batch_size=BATCH,
+                           mesh=default_mesh(devices=[device]))
+        if device.id == jax.devices()[0].id:
+            real = eng._rules_flush
+
+            def flaky(*a, **k):
+                if not booms:
+                    booms.append(device.id)
+                    raise RuntimeError("injected rules fault")
+                return real(*a, **k)
+
+            eng._rules_flush = flaky
+        return eng
+
+    st_log = []
+    st = _eng(lines).crack_rules_streams(
+        frame_blocks(iter(words), BATCH), rules,
+        on_batch=lambda c, f: st_log.append((c, sorted(x.psk for x in f))),
+        devices=jax.devices()[:2], engine_factory=factory)
+
+    assert booms  # the fault actually fired
+    assert _keys(st) == _keys(ref)
+    assert st_log == ref_log
+
+
+def test_rbase_warm_cold_parity(tmp_path):
+    """The .rbase species: the cold run (tee write-back) and the warm
+    replay (pre-split RulesPrep blocks) produce bit-identical block
+    geometry, found lists and consumed totals; a warm base-word skip
+    seeks the chunk index instead of replaying the gunzip stream."""
+    lines, words, rules = _lines(), _words(), _rules()
+    blob = gzip.compress(b"\n".join(words) + b"\n")
+    path = os.path.join(str(tmp_path), "mesh.txt.gz")
+    with open(path, "wb") as f:
+        f.write(blob)
+    dhash = hashlib.md5(blob).hexdigest()
+    cache = DictCache(str(tmp_path / "cache"))
+    units = [(path, dhash)]
+
+    def run(skip_words=0):
+        log = []
+        src = RulesFeedSource(units, batch_size=BATCH, cache=cache,
+                              skip=skip_words)
+        founds = _eng(lines).crack_rules_blocks(
+            iter(src), rules,
+            on_batch=lambda c, f: log.append((c, sorted(x.psk for x in f))))
+        return founds, log
+
+    cold_founds, cold_log = run()
+    assert cache.reader_rules(dhash) is not None  # tee committed
+    warm_founds, warm_log = run()
+    assert _keys(warm_founds) == _keys(cold_founds) \
+        == [(ESSID, PSK_U), (ESSID, PSK_C)]
+    assert warm_log == cold_log
+
+    # warm pre-split blocks carry the RulesPrep marker end to end
+    src = RulesFeedSource(units, batch_size=BATCH, cache=cache)
+    blocks = list(src)
+    # block counts are BASE words; on_batch reports the expanded domain
+    assert [b.count * len(rules) for b in blocks] == [c for c, _ in cold_log]
+    assert all(hasattr(b.prep, "rules_base") for b in blocks)
+
+    # base-word skip past block 0: warm seek covers exactly the tail
+    skip_founds, skip_log = run(skip_words=BATCH)
+    assert _keys(skip_founds) == [(ESSID, PSK_C)]
+    assert [c for c, _ in skip_log] == [c for c, _ in cold_log[1:]]
