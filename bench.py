@@ -897,6 +897,110 @@ def bench_resilience(batch: int = None, words: int = 20_000,
             "recompiles_faulted": comp.count}
 
 
+def bench_server_load(sessions: int = 2000, threads: int = 16,
+                      nets: int = 200, dicts: int = 20) -> dict:
+    """Server core under a loopback client storm (epoch-leased scheduler
+    + admission control, PR: crash-safe server core).
+
+    ``sessions`` client sessions (each a get_work -> put_work release
+    pair over ``chaos.WsgiTransport``, naps on a VirtualClock) are driven
+    by ``threads`` workers against two same-geometry servers: the legacy
+    per-request scheduling scan (``use_queue=False``) and the
+    precomputed issuable-unit queue.  Reports issues/s, accepts/s and
+    the server-side p99 request latency from the
+    ``dwpa_http_request_seconds`` histogram; ``queue_speedup`` is the
+    issues/s ratio (queue over scan — the pop path must win).
+    """
+    import json as _json
+    import threading as _threading
+
+    from dwpa_tpu.chaos import VirtualClock, WsgiTransport
+    from dwpa_tpu.obs import MetricsRegistry
+    from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+    # capacity: nets x dicts issuable units must cover the sessions
+    assert nets * dicts >= 2 * sessions, "geometry too small for sessions"
+
+    def build_server(use_queue):
+        reg = MetricsRegistry()
+        core = ServerCore(Database(":memory:"), registry=reg,
+                          use_queue=use_queue, max_inflight=0)
+        lines = [T.make_pmkid_line(b"load-psk-%04d" % i,
+                                   b"LoadNet%04d" % i, seed=f"load{i}")
+                 for i in range(nets)]
+        core.add_hashlines(lines)
+        core.db.x("UPDATE nets SET algo = ''")
+        for i in range(dicts):
+            core.add_dict(f"dict/load{i}.txt.gz", f"load{i}",
+                          "0" * 32, 1000 + i)
+        return core, make_wsgi_app(core)
+
+    def p99(reg):
+        fam = reg.histogram("dwpa_http_request_seconds")
+        counts = [0] * (len(fam.bucket_bounds) + 1)
+        total = 0
+        for child in list(fam._children.values()):
+            total += child.value
+            for i, c in enumerate(child.buckets):
+                counts[i] += c
+        if not total:
+            return 0.0
+        need, acc = 0.99 * total, 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= need:
+                return fam.bucket_bounds[i] if i < len(fam.bucket_bounds) \
+                    else float("inf")
+        return float("inf")
+
+    def run_leg(use_queue, span):
+        core, app = build_server(use_queue)
+        issued = [0] * threads
+        accepted = [0] * threads
+        clock = VirtualClock()
+
+        def worker(w):
+            wsgi = WsgiTransport(app)
+            body = _json.dumps({"dictcount": 1}).encode()
+            for _ in range(sessions // threads):
+                try:
+                    raw = wsgi("http://loop/?get_work=2.2.0", body,
+                               {"Content-Type": "application/json"})
+                except Exception:
+                    clock.sleep(0.01)  # 429/503: virtual nap, retry next
+                    continue
+                if raw in (b"No nets", b"Version"):
+                    continue
+                work = _json.loads(raw)
+                issued[w] += 1
+                sub = _json.dumps({"hkey": work["hkey"],
+                                   "epoch": work["epoch"],
+                                   "cand": []}).encode()
+                try:
+                    if wsgi("http://loop/?put_work", sub,
+                            {"Content-Type": "application/json"}) == b"OK":
+                        accepted[w] += 1
+                except Exception:
+                    clock.sleep(0.01)
+
+        ts = [_threading.Thread(target=worker, args=(w,))
+              for w in range(threads)]
+        s = _timed(lambda: [[t.start() for t in ts],
+                            [t.join() for t in ts]], span)
+        return {"issued": sum(issued), "accepted": sum(accepted),
+                "issues_per_s": sum(issued) / s,
+                "accepts_per_s": sum(accepted) / s,
+                "p99_request_s": p99(core.registry), "seconds": s}
+
+    scan = run_leg(False, "bench:server_load_scan")
+    queue = run_leg(True, "bench:server_load_queue")
+    return {"label": "server_load", "sessions": sessions,
+            "threads": threads, "nets": nets, "dicts": dicts,
+            "scan": scan, "queue": queue,
+            "queue_speedup": (queue["issues_per_s"]
+                              / max(scan["issues_per_s"], 1e-9))}
+
+
 def _timed(fn, name: str = "bench:timed") -> float:
     """One rep as a span: the body must sync its own device work (every
     caller passes an engine crack* call, which does)."""
@@ -1022,6 +1126,7 @@ def main():
     mesh_agg = bench_mesh_aggregate()
     overhead = bench_unit_overhead(pmkid)
     resilience = bench_resilience(batch)
+    server_load = bench_server_load()
 
     value = mask["pmk_per_s"]
     print(
@@ -1050,6 +1155,7 @@ def main():
                     "mesh_aggregate": _round(mesh_agg),
                     "unit_overhead": _round(overhead),
                     "resilience": _round(resilience),
+                    "server_load": _round(server_load),
                 },
             }
         )

@@ -123,6 +123,14 @@ Rule codes (stable — referenced by baseline.json and the docs):
   (the host ships compact base blocks, not expanded candidates).  The
   engine's own host tail (``@``-purge rules, length-overflow pairs)
   lives in ``models/m22000.py``, outside this scope by design.
+- **DW114 server-db-atomicity** — the server persistence contract
+  (``dwpa_tpu/server/``): two or more ``db.x(...)`` write sites in one
+  function body, outside a ``with db.tx():`` block, are a torn-write
+  hazard — a crash (or an injected ``chaos.dbfault``) between them
+  leaves the ledger half-updated.  Multi-statement sequences belong
+  inside ``Database.tx()``; a SINGLE lexical write site is fine even
+  in a loop (per-row autocommit around network calls, e.g. geolocate,
+  is a deliberate pattern, not a tear).
 
 The linter is repo-native, not general-purpose: rules are scoped to the
 paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
@@ -149,6 +157,9 @@ SPAN_FILES = ("bench.py", "dwpa_tpu/client/main.py")
 #: file inside it allowed to speak raw HTTP / own the backoff sleeps
 CLIENT_DIR = "dwpa_tpu/client/"
 CLIENT_TRANSPORT_FILE = "dwpa_tpu/client/protocol.py"
+
+#: the package whose multi-statement write atomicity DW114 polices
+SERVER_DIR = "dwpa_tpu/server/"
 
 #: metric-emission methods DW106 bans inside traced functions
 OBS_EMIT_METHODS = {"inc", "dec", "observe", "set"}
@@ -1114,6 +1125,63 @@ def _check_rules_device_expansion(tree, path, src_lines, out):
 
 
 # ---------------------------------------------------------------------------
+# DW114: server db write atomicity
+# ---------------------------------------------------------------------------
+
+
+def _is_db_tx_with(node: ast.With) -> bool:
+    """True for ``with <db>.tx():`` (receiver named ``db`` — covers
+    ``db``, ``self.db``, ``core.db``)."""
+    for item in node.items:
+        ctx = item.context_expr
+        if (isinstance(ctx, ast.Call) and isinstance(ctx.func, ast.Attribute)
+                and ctx.func.attr == "tx"
+                and _recv_name(ctx.func) == "db"):
+            return True
+    return False
+
+
+def _check_server_db_atomicity(tree, path, src_lines, out):
+    """DW114: >=2 lexical ``db.x(...)`` write sites in one function,
+    outside any ``with db.tx():`` block.
+
+    Counts call SITES, not executions: one ``db.x`` inside a loop is a
+    deliberate per-row-autocommit pattern (safe to tear between rows —
+    each row is self-contained); two sites mean two statements whose
+    combined effect the caller almost certainly assumed atomic.  Nested
+    function bodies are analyzed separately so an inner helper's write
+    never inflates its parent's count."""
+
+    def visit(node, in_tx, sites):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scope: counted on its own visit
+        if isinstance(node, ast.With) and _is_db_tx_with(node):
+            in_tx = True
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "x"
+                and _recv_name(node.func) == "db" and not in_tx):
+            sites.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_tx, sites)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sites = []
+        for stmt in node.body:
+            visit(stmt, False, sites)
+        if len(sites) >= 2:
+            first = sites[0]
+            out.append(Violation(
+                "DW114", path, first.lineno,
+                f"{len(sites)} db.x() write sites in {node.name}() outside "
+                "Database.tx() — a crash between them tears the ledger; "
+                "wrap the sequence in 'with db.tx():' (or self.db.tx())",
+                _line(src_lines, first)))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1154,6 +1222,8 @@ def lint_source(src: str, path: str) -> list:
         _check_rules_device_expansion(tree, path, src_lines, out)
     if path.startswith(CLIENT_DIR) and path != CLIENT_TRANSPORT_FILE:
         _check_client_transport(tree, path, src_lines, out)
+    if path.startswith(SERVER_DIR):
+        _check_server_db_atomicity(tree, path, src_lines, out)
     return out
 
 

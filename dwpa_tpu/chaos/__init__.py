@@ -13,6 +13,8 @@ is a one-line repro, not a flake.
 from .plan import FAULT_KINDS, FaultPlan
 from .transport import ChaosTransport, VirtualClock, WsgiTransport
 from .fsfault import FsFaultInjector, flip_byte, tear_tail
+from .dbfault import (DB_FAULT_KINDS, DbFaultPlan, SimulatedCrash,
+                      install as install_db_faults, sweep_invariants)
 
 __all__ = [
     "FAULT_KINDS",
@@ -23,4 +25,9 @@ __all__ = [
     "FsFaultInjector",
     "flip_byte",
     "tear_tail",
+    "DB_FAULT_KINDS",
+    "DbFaultPlan",
+    "SimulatedCrash",
+    "install_db_faults",
+    "sweep_invariants",
 ]

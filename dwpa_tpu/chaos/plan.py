@@ -24,15 +24,19 @@ import random
 #   truncate  response body cut in half
 #   garbage   response body replaced with non-JSON bytes
 #   http_4xx  HTTP 404 (classified permanent)
+#   http_429  HTTP 429 + Retry-After (server admission control; transient)
 #   http_5xx  HTTP 503 (classified transient)
 #   slow      response delayed by ``slow_s``
 #   reject    response body replaced with a non-OK refusal
 FAULT_KINDS = ("drop", "timeout", "truncate", "garbage",
-               "http_4xx", "http_5xx", "slow", "reject")
+               "http_4xx", "http_429", "http_5xx", "slow", "reject")
 
 # Kinds safe for blanket probabilistic injection: every one is either
 # retried as transient or re-fetched by validation — a schedule of these
-# never makes a correct client lose work.
+# never makes a correct client lose work.  http_429 is transient too but
+# deliberately NOT listed: adding a kind here would shift every existing
+# seeded schedule's uniform draws — 429s are injected via force() or an
+# explicit kinds= override instead.
 TRANSIENT_KINDS = ("drop", "timeout", "truncate", "garbage", "http_5xx",
                    "slow")
 

@@ -58,6 +58,10 @@ class ChaosTransport:
         if kind == "http_4xx":
             raise urllib.error.HTTPError(
                 url, 404, "chaos: injected 404", None, io.BytesIO(b""))
+        if kind == "http_429":
+            raise urllib.error.HTTPError(
+                url, 429, "chaos: injected 429",
+                {"Retry-After": "2"}, io.BytesIO(b"overloaded"))
         if kind == "http_5xx":
             raise urllib.error.HTTPError(
                 url, 503, "chaos: injected 503", None, io.BytesIO(b""))
@@ -104,11 +108,14 @@ class WsgiTransport:
 
         def start_response(status, headers_out):
             captured["status"] = status
+            captured["headers"] = dict(headers_out)
 
         chunks = self.app(environ, start_response)
         data = b"".join(chunks)
         code = int(captured["status"].split()[0])
         if not 200 <= code < 300:
+            # headers ride along so Retry-After reaches the retry stack
             raise urllib.error.HTTPError(
-                url, code, captured["status"], None, io.BytesIO(data))
+                url, code, captured["status"], captured["headers"],
+                io.BytesIO(data))
         return data

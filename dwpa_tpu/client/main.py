@@ -1072,7 +1072,8 @@ class TpuCrackClient:
             acc = err = None
             if jax.process_index() == 0:
                 try:
-                    acc = self._submit(work["hkey"], cand)
+                    acc = self._submit(work["hkey"], cand,
+                                       epoch=work.get("epoch"))
                 except ConnectionError:
                     acc = False  # journaled; the outbox drain retries
                 except Exception as e:
@@ -1084,7 +1085,8 @@ class TpuCrackClient:
             result.accepted = bool(payload["acc"])
         else:
             try:
-                result.accepted = self._submit(work["hkey"], cand)
+                result.accepted = self._submit(work["hkey"], cand,
+                                               epoch=work.get("epoch"))
             except ConnectionError as e:
                 # Degraded mode: the founds were journaled before the
                 # attempt — delivery now belongs to the outbox drain, so
@@ -1107,13 +1109,15 @@ class TpuCrackClient:
         honored as-is."""
         return self.api.max_tries or 2
 
-    def _submit(self, hkey: str, cand: list) -> bool:
+    def _submit(self, hkey: str, cand: list, epoch: int = None) -> bool:
         """Journal-then-send one unit's founds; acks on server OK.
 
         The outbox ``record`` is the durability point — it fsyncs before
         the first ``put_work`` attempt and drops any (hkey, bssid) the
         server already acked, so a resume-replay re-crack after a
-        restart cannot double-submit."""
+        restart cannot double-submit.  ``epoch`` (from the work unit)
+        keys the lease release server-side; outbox drains pass None and
+        the server resolves the live epoch."""
         to_send = self.outbox.record(hkey, cand)
         if not to_send:
             # Nothing the server doesn't already have (all acked, or an
@@ -1121,9 +1125,11 @@ class TpuCrackClient:
             if cand:
                 return True
             return self.api.put_work(hkey, cand,
-                                     max_tries=self._submit_tries())
+                                     max_tries=self._submit_tries(),
+                                     epoch=epoch)
         accepted = self.api.put_work(hkey, to_send,
-                                     max_tries=self._submit_tries())
+                                     max_tries=self._submit_tries(),
+                                     epoch=epoch)
         if accepted:
             self.outbox.ack(hkey, to_send)
         return accepted

@@ -83,6 +83,10 @@ def classify_error(exc) -> tuple:
     the old flat loop caught ``URLError`` and retried a 404 forever.
     """
     if isinstance(exc, urllib.error.HTTPError):
+        if exc.code == 429:
+            # Admission control, not rejection: the server is up and
+            # explicitly asking us to come back (Retry-After).
+            return "transient", "http_429"
         kind = "permanent" if 400 <= exc.code < 500 else "transient"
         return kind, f"http_{exc.code // 100}xx"
     if isinstance(exc, TimeoutError):
@@ -103,6 +107,25 @@ def classify_error(exc) -> tuple:
     if isinstance(exc, (ConnectionError, OSError)):
         return "transient", "conn"
     return "transient", "error"
+
+
+def retry_after_floor(exc) -> float:
+    """Server-requested minimum backoff from a Retry-After header, or
+    0.0 when the response carried none (or carried garbage).  Only the
+    delta-seconds form is parsed — HTTP-date Retry-After is not worth a
+    date parser here; a malformed value must never break the retry loop.
+    """
+    headers = getattr(exc, "headers", None)
+    if headers is None:
+        return 0.0
+    try:
+        value = headers.get("Retry-After")
+    except AttributeError:
+        return 0.0
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return 0.0
 
 
 class RetryPolicy:
@@ -339,6 +362,9 @@ class ServerAPI:
                 delay = state.next_delay()
                 if delay is None:
                     raise ConnectionError(f"giving up on {url}: {e}") from e
+                # A 429/503 Retry-After is a floor, not a replacement:
+                # jittered exponential backoff still applies above it.
+                delay = max(delay, retry_after_floor(e))
                 self._note_retry(endpoint, reason, delay)
                 if self._obs_tracer is not None:
                     with self._obs_tracer.span("transport:retry"):
@@ -389,12 +415,24 @@ class ServerAPI:
                 continue
             return work
 
-    def put_work(self, hkey: str, candidates: list, max_tries: int = None) -> bool:
-        """``candidates``: [{"k": bssid-12hex, "v": psk-hex}, ...]."""
+    def put_work(self, hkey: str, candidates: list, max_tries: int = None,
+                 epoch: int = None) -> bool:
+        """``candidates``: [{"k": bssid-12hex, "v": psk-hex}, ...].
+
+        ``epoch`` echoes the lease epoch from the issuing get_work; a
+        stale holder (its lease reaped and the unit reissued) then fails
+        the keyed release instead of double-crediting.  None (drained
+        outbox records from before the epoch era, or old servers) lets
+        the server resolve the live epoch itself.
+        """
+        payload = {"hkey": hkey, "type": "bssid", "cand": candidates,
+                   "epoch": epoch}
+        if epoch is None:
+            del payload["epoch"]  # byte-compatible with reference servers
         with self._observed("put_work"):
             raw = self.fetch(
                 self._endpoint("put_work"),
-                {"hkey": hkey, "type": "bssid", "cand": candidates},
+                payload,
                 max_tries=max_tries,
             )
         return raw.decode("utf-8", "replace").strip() == "OK"

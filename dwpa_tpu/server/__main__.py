@@ -50,6 +50,8 @@ def _core(args):
         hcdir=getattr(args, "hcdir", None),
         base_url=getattr(args, "base_url", None) or "",
         capture_cap=getattr(args, "capture_cap", None),
+        max_inflight=getattr(args, "max_inflight", None),
+        use_queue=not getattr(args, "no_work_queue", False),
     )
     if getattr(args, "recaptcha_secret", None):
         from .external import RECAPTCHA_URL, RecaptchaVerifier
@@ -117,7 +119,8 @@ def cmd_serve(args):
     from ..obs import setup_logging
 
     setup_logging()
-    app = make_wsgi_app(_core(args))
+    serve_core = _core(args)
+    app = make_wsgi_app(serve_core)
     if getattr(args, "with_jobs", False):
         # The cron layer in-process: its own ServerCore (sqlite handles
         # are not shared across threads; WAL serializes the writers).
@@ -135,8 +138,32 @@ def cmd_serve(args):
     port = args.port if args.port is not None else 8080
     with make_server(host, port, app,
                      server_class=ThreadingWSGIServer) as srv:
+        _start_materializer(serve_core)
         print(f"dwpa_tpu server on http://{host}:{port}/", flush=True)
         srv.serve_forever()
+
+
+def _start_materializer(core, interval: float = 1.0):
+    """Background issuable-queue refill for ``serve``: keeps get_work on
+    the O(1) pop path instead of the inline refill scan.  No-op when the
+    queue is disabled (--no-work-queue)."""
+    import threading
+
+    if core.queue is None:
+        return None
+
+    def loop():
+        while True:
+            try:
+                core.materialize_queue()
+            except Exception:
+                pass  # transient sqlite contention: next tick retries
+            time.sleep(interval)
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="dwpa-queue-materializer")
+    t.start()
+    return t
 
 
 def _geo_lookup_from_file(path):
@@ -380,6 +407,15 @@ def main(argv=None):
                     help="capture upload size bound in bytes, raw and "
                          "gzip-decompressed (default 8 MiB — the reference's "
                          "deployment-tunable PHP upload limit)")
+    sp.add_argument("--max-inflight", dest="max_inflight", type=int,
+                    default=None,
+                    help="admission-control cap on live leases; extra "
+                         "get_work calls get HTTP 429 + Retry-After "
+                         "(default 4096, 0 disables)")
+    sp.add_argument("--no-work-queue", dest="no_work_queue",
+                    action="store_true",
+                    help="disable the precomputed issuable-unit queue and "
+                         "fall back to per-request table scans")
     sp.add_argument("--with-jobs", action="store_true",
                     help="run the cron layer as a background thread of "
                          "this process (single-process deployment)")

@@ -27,10 +27,11 @@ import json
 import gzip
 import os
 import re
+import sqlite3
 import time
 import urllib.parse
 
-from .core import ServerCore
+from .core import OVERLOAD_RETRY_AFTER_S, Overloaded, ServerCore
 from .capture import extract_hashlines
 
 MIN_HC_VER = "2.1.1"  # oldest client protocol accepted (conf.php:29)
@@ -105,6 +106,18 @@ def make_wsgi_app(core: ServerCore, registry=None):
             out = ("413 Content Too Large", "text/plain", b"capture too large")
         except ValueError as e:
             out = ("400 Bad Request", "text/plain", str(e).encode())
+        except Overloaded as e:
+            # Admission control (core.max_inflight): shed with 429 + a
+            # Retry-After the client RetryPolicy honors as its backoff
+            # floor — overload composes with retries, not against them.
+            out = ("429 Too Many Requests", "text/plain", b"overloaded",
+                   [("Retry-After", str(max(1, round(e.retry_after))))])
+        except sqlite3.OperationalError:
+            # Transient DB-layer refusal ("database is locked", disk I/O):
+            # the request may retry once the writer drains — a 503, not a
+            # crash page, so the client classifies it transient.
+            out = ("503 Service Unavailable", "text/plain", b"database busy",
+                   [("Retry-After", str(OVERLOAD_RETRY_AFTER_S))])
         status, ctype, body = out[:3]
         extra_headers = list(out[3]) if len(out) > 3 else []
         endpoint = _endpoint_label(environ, qs)
@@ -254,7 +267,16 @@ def _route(core: ServerCore, environ):
             req = json.loads(_read_body(environ) or b"{}")
         except ValueError:
             req = {}
-        work = core.get_work(int(req.get("dictcount", 1)))
+        raw_dc = req.get("dictcount", 1) if isinstance(req, dict) else 1
+        try:
+            dictcount = int(raw_dc)
+        except (TypeError, ValueError):
+            # client-supplied JSON: a non-numeric dictcount (string,
+            # list, object) must get a clean 400, not a traceback —
+            # int() raises TypeError on containers, which the generic
+            # ValueError->400 net would NOT catch.
+            return "400 Bad Request", "text/plain", b"bad dictcount"
+        work = core.get_work(dictcount)
         if work is None:
             return "200 OK", "text/plain", b"No nets"
         return "200 OK", "application/json", json.dumps(work).encode()

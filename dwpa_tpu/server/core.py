@@ -33,12 +33,87 @@ import time
 
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
+from ..utils.fsio import fsync_replace
 from .db import Database, mac2long, now
 
 MAX_CANDS_PER_PUT = 200     # put_work cap (reference: common.php:937)
 MAX_DICTCOUNT = 15          # dictcount clamp (get_work.php:41-46)
 LEASE_REAP_S = 3 * 3600     # stale work-unit reclaim (maint.php:36)
 SERVER_NC = 128             # server-side NC search width (common.php:157)
+MAX_INFLIGHT = 4096         # default bound on live work-unit leases
+OVERLOAD_RETRY_AFTER_S = 2  # Retry-After hint handed to shed clients
+LEASE_RETENTION_S = 7 * 86400  # released/reaped lease rows kept this long
+
+
+class Overloaded(Exception):
+    """get_work admission control refused: the live-lease count is at the
+    in-flight cap.  The WSGI layer answers 429 + ``Retry-After`` (which
+    the PR-10 client RetryPolicy honors as a backoff floor)."""
+
+    def __init__(self, retry_after: float = OVERLOAD_RETRY_AFTER_S):
+        super().__init__(f"work-unit leases at capacity; "
+                         f"retry after {retry_after:.0f}s")
+        self.retry_after = retry_after
+
+
+class WorkQueue:
+    """Precomputed issuable-target queue with sharded-lock pop.
+
+    The materializer (inline on miss, or the background refill thread /
+    jobs tick) runs the scheduling scan ONCE for a batch of targets;
+    ``get_work`` then pops candidate net_ids in O(1) instead of
+    re-running the ORDER BY hits,ts scan per request.  Entries are
+    hints, not reservations — every pop is revalidated (net still
+    uncracked/released, untried dicts remain) inside the issuing
+    transaction, so staleness costs a retry, never correctness.
+
+    Push/pop distribute round-robin over ``shards`` deques, each behind
+    its own lock, so concurrent poppers do not serialize on one mutex;
+    ordering is approximately FIFO (exact enough for the scheduler,
+    whose order is a heuristic to begin with).
+    """
+
+    def __init__(self, shards: int = 8):
+        self._shards = [[] for _ in range(max(1, int(shards)))]
+        self._locks = [threading.Lock() for _ in self._shards]
+        self._push = 0  # monotonic counters; races only skew round-robin
+        self._pop = 0
+
+    def __len__(self):
+        return sum(len(s) for s in self._shards)
+
+    def push_many(self, items):
+        for it in items:
+            i = self._push % len(self._shards)
+            self._push += 1
+            with self._locks[i]:
+                self._shards[i].append(it)
+
+    def pop(self):
+        n = len(self._shards)
+        start = self._pop
+        self._pop += 1
+        for off in range(n):
+            i = (start + off) % n
+            with self._locks[i]:
+                if self._shards[i]:
+                    return self._shards[i].pop(0)
+        return None
+
+    def discard(self, items):
+        """Drop queued hints (e.g. every member of a just-leased SSID
+        group): a sibling hint left behind would out-rank a never-tried
+        net on the next pop, diverging from the scan's min-hits order."""
+        drop = set(items)
+        for i, lock in enumerate(self._locks):
+            with lock:
+                self._shards[i] = [x for x in self._shards[i]
+                                   if x not in drop]
+
+    def clear(self):
+        for i, lock in enumerate(self._locks):
+            with lock:
+                self._shards[i].clear()
 
 
 def gen_key() -> str:
@@ -127,10 +202,20 @@ class ServerCore:
     def __init__(self, db: Database, dictdir: str = None, capdir: str = None,
                  mailer=None, bosskey: str = None, captcha=None,
                  base_url: str = "", hcdir: str = None,
-                 capture_cap: int = None, registry=None):
+                 capture_cap: int = None, registry=None,
+                 max_inflight: int = None, use_queue: bool = True,
+                 queue_batch: int = 256):
         from ..obs import default_registry
 
         self.db = db
+        # Admission control: get_work sheds load (Overloaded -> HTTP 429)
+        # once this many work-unit leases are live.  None -> MAX_INFLIGHT;
+        # 0 disables the cap.
+        self.max_inflight = MAX_INFLIGHT if max_inflight is None else max_inflight
+        # Precomputed issuable-target queue (None = legacy per-request
+        # scheduling scan; bench:server_load compares the two paths).
+        self.queue = WorkQueue() if use_queue else None
+        self.queue_batch = queue_batch
         # Telemetry sink shared by the WSGI front (api.make_wsgi_app
         # reuses it), the scheduler counters below, and the cron jobs
         # (jobs.py); injectable so tests get isolated registries.
@@ -140,6 +225,9 @@ class ServerCore:
         self._m_claims = self.registry.counter(
             "dwpa_server_claims_total",
             "put_work candidate claims, by verification verdict")
+        self._m_overload = self.registry.counter(
+            "dwpa_server_overload_rejects_total",
+            "get_work requests shed by the in-flight lease cap (HTTP 429)")
         self.dictdir = dictdir
         self.capdir = capdir
         # Upload size bound for captures (raw AND gzip-decompressed);
@@ -189,8 +277,14 @@ class ServerCore:
             day = time.strftime("%Y/%m/%d")
             os.makedirs(os.path.join(self.capdir, day), exist_ok=True)
             localfile = os.path.join(self.capdir, day, md5.hex())
-            with open(localfile, "wb") as f:
+            # tmp + fsync + rename (fsio): the DB row inserted below must
+            # never point at a torn capture file after a crash — the
+            # final name either holds the complete blob or nothing.
+            tmp = "%s.tmp.%d.%x" % (localfile, os.getpid(),
+                                    threading.get_ident())
+            with open(tmp, "wb") as f:
                 f.write(blob)
+            fsync_replace(tmp, localfile)
         # OR IGNORE + re-select: under the threaded server two identical
         # uploads can both pass the dedup SELECT; the UNIQUE(hash) row
         # must win quietly, not 500 the second client.
@@ -205,7 +299,17 @@ class ServerCore:
 
     def add_hashlines(self, lines, s_id: int = None, ip: str = "",
                       userkey: str = None) -> dict:
-        """Ingest parsed/parsable m22000 lines; returns a report dict."""
+        """Ingest parsed/parsable m22000 lines; returns a report dict.
+
+        The whole batch — per-line net inserts plus the user association
+        — commits as ONE transaction: a crash mid-ingestion leaves no
+        half-recorded submission (nets without their n2u rows, or a
+        partial batch that would double-count on replay).
+        """
+        with self.db.tx():
+            return self._add_hashlines_tx(lines, s_id, ip, userkey)
+
+    def _add_hashlines_tx(self, lines, s_id, ip, userkey) -> dict:
         report = {"new": 0, "dup": 0, "bad": 0, "precracked": 0}
         new_ids = []
         for line in lines:
@@ -262,25 +366,27 @@ class ServerCore:
 
     def add_probe_requests(self, ssids, s_id: int):
         """PROBEREQUEST ssids -> prs/p2s (source of the dynamic dict)."""
-        for ssid in ssids:
-            if not ssid or len(ssid) > 32:
-                continue
-            self.db.x("INSERT OR IGNORE INTO prs(ssid) VALUES (?)", (ssid,))
-            p = self.db.q1("SELECT p_id FROM prs WHERE ssid = ?", (ssid,))
-            self.db.x(
-                "INSERT OR IGNORE INTO p2s(p_id, s_id) VALUES (?, ?)",
-                (p["p_id"], s_id),
-            )
+        with self.db.tx():
+            for ssid in ssids:
+                if not ssid or len(ssid) > 32:
+                    continue
+                self.db.x("INSERT OR IGNORE INTO prs(ssid) VALUES (?)", (ssid,))
+                p = self.db.q1("SELECT p_id FROM prs WHERE ssid = ?", (ssid,))
+                self.db.x(
+                    "INSERT OR IGNORE INTO p2s(p_id, s_id) VALUES (?, ?)",
+                    (p["p_id"], s_id),
+                )
 
     def associate_user(self, userkey: str, net_ids):
         u = self.db.q1("SELECT u_id FROM users WHERE userkey = ?", (userkey,))
         if not u:
             return
-        for nid in net_ids:
-            self.db.x(
-                "INSERT OR IGNORE INTO n2u(net_id, u_id) VALUES (?, ?)",
-                (nid, u["u_id"]),
-            )
+        with self.db.tx():
+            for nid in net_ids:
+                self.db.x(
+                    "INSERT OR IGNORE INTO n2u(net_id, u_id) VALUES (?, ?)",
+                    (nid, u["u_id"]),
+                )
 
     def _handshakes_like(self, h: hl.Hashline, n_state: int):
         """Nets sharing ssid OR bssid OR mac_sta (PMK-reuse candidates,
@@ -311,24 +417,92 @@ class ServerCore:
         """Build one work unit or return None ("No nets").
 
         Held under the global get_work mutex (the reference's SHM lock,
-        get_work.php:49,138): target selection and lease recording must
-        be atomic with respect to other volunteers.
+        get_work.php:49,138) AND inside one ``db.tx()``: target selection
+        and lease recording are atomic with respect to other volunteers,
+        and a kill at any statement boundary either issues the whole
+        unit (lease row + every coverage row) or nothing.
+        Raises :class:`Overloaded` when live leases hit ``max_inflight``.
         """
         with self._getwork_lock:
-            work = self._get_work_locked(dictcount)
+            with self.db.tx():
+                if self.max_inflight:
+                    live = self.db.q1(
+                        "SELECT COUNT(*) c FROM leases WHERE state = 0")["c"]
+                    if live >= self.max_inflight:
+                        self._m_overload.inc()
+                        raise Overloaded()
+                work = self._get_work_locked(dictcount)
         if work is not None:
             self._m_issued.inc()
         return work
 
     def _get_work_locked(self, dictcount: int) -> dict:
         dictcount = max(1, min(MAX_DICTCOUNT, int(dictcount)))
-        target = self.db.q1(
-            """SELECT net_id, ssid FROM nets
+        for target in self._targets():
+            work = self._lease_unit(target, dictcount)
+            if work is not None:
+                return work
+        return None
+
+    def _targets(self):
+        """Candidate scheduling targets, best first.
+
+        Queue path: pop precomputed net_ids (each revalidated against the
+        live row — pops are hints) and refill inline at most once when
+        the queue runs dry, so correctness never depends on the
+        background materializer being alive.  Scan path (queue is None):
+        the legacy per-request ORDER BY hits,ts scan.
+        """
+        if self.queue is None:
+            target = self.db.q1(
+                """SELECT net_id, ssid FROM nets
+                   WHERE n_state = 0 AND algo = ''
+                   ORDER BY hits, ts LIMIT 1"""
+            )
+            if target:
+                yield target
+            return
+        refilled = False
+        while True:
+            net_id = self.queue.pop()
+            if net_id is None:
+                if refilled:
+                    return
+                refilled = True
+                self.materialize_queue()
+                continue
+            row = self.db.q1(
+                """SELECT net_id, ssid FROM nets
+                   WHERE net_id = ? AND n_state = 0 AND algo = ''""",
+                (net_id,),
+            )
+            if row is not None:
+                yield row
+
+    def materialize_queue(self, limit: int = None) -> int:
+        """Run the scheduling scan once and queue a batch of issuable
+        targets (uncracked, released, with at least one untried dict) in
+        scheduler order.  Called inline when the queue runs dry and by
+        the background materializer (jobs tick / serve refill thread).
+        Returns the number of targets queued."""
+        if self.queue is None:
+            return 0
+        if len(self.queue) > 0:
+            return 0  # refill only from empty: stale entries age out fast
+        rows = self.db.q(
+            """SELECT net_id FROM nets
                WHERE n_state = 0 AND algo = ''
-               ORDER BY hits, ts LIMIT 1"""
+                 AND hits < (SELECT COUNT(*) FROM dicts)
+               ORDER BY hits, ts LIMIT ?""",
+            (limit or self.queue_batch,),
         )
-        if not target:
-            return None
+        self.queue.push_many([r["net_id"] for r in rows])
+        return len(rows)
+
+    def _lease_unit(self, target, dictcount: int) -> dict:
+        """Issue one epoch-leased unit for ``target``, or None when the
+        target has no untried dicts left (caller moves to the next
+        target).  Runs inside the caller's transaction (tx() nests)."""
         dicts = self.db.q(
             """SELECT * FROM dicts WHERE d_id NOT IN
                  (SELECT d_id FROM n2d WHERE net_id = ?)
@@ -350,12 +524,22 @@ class ServerCore:
         if not nets:
             return None
         hkey = gen_key()
-        for n in nets:
-            for d in d_ids:
-                self.db.x(
-                    "INSERT OR IGNORE INTO n2d(net_id, d_id, hkey) VALUES (?,?,?)",
-                    (n["net_id"], d, hkey),
-                )
+        with self.db.tx():
+            epoch = self.db.q1(
+                "SELECT COALESCE(MAX(epoch), 0) + 1 e FROM leases")["e"]
+            self.db.x(
+                "INSERT INTO leases(hkey, epoch, issued) VALUES (?, ?, ?)",
+                (hkey, epoch, now()),
+            )
+            for n in nets:
+                for d in d_ids:
+                    self.db.x(
+                        "INSERT OR IGNORE INTO n2d(net_id, d_id, hkey, epoch) "
+                        "VALUES (?,?,?,?)",
+                        (n["net_id"], d, hkey, epoch),
+                    )
+        if self.queue is not None:
+            self.queue.discard(n["net_id"] for n in nets)
         # merged, deduped per-dict rules (get_work.php:84-92)
         seen, merged = set(), []
         for d in dicts:
@@ -365,6 +549,7 @@ class ServerCore:
                     merged.append(ln)
         work = {
             "hkey": hkey,
+            "epoch": epoch,
             "dicts": [{"dhash": d["dhash"], "dpath": d["dpath"]} for d in dicts],
             "hashes": [n["struct"] for n in nets],
         }
@@ -411,31 +596,73 @@ class ServerCore:
     # ------------------------------------------------------------------
 
     def put_work(self, data: dict) -> bool:
+        """Accept one submission: verify claims, then release the lease.
+
+        The whole call — every accept cascade plus the lease release —
+        runs under the scheduler mutex and ONE transaction, so a kill at
+        any statement boundary leaves no half-accepted net.  The release
+        is keyed by ``(hkey, epoch, state=live)``: a stale holder whose
+        unit was reaped and re-issued matches nothing, and a duplicate
+        submit is an idempotent no-op (the lease state only leaves
+        "live" once).
+        """
         cands = data.get("cand") or []
         ctype = data.get("type", "bssid")
         hkey = data.get("hkey")
+        epoch = data.get("epoch")
+        if not isinstance(epoch, int) or isinstance(epoch, bool):
+            epoch = None  # absent/garbage epoch: resolve from the live lease
         if not isinstance(cands, list):
             return False
-        for pair in cands[:MAX_CANDS_PER_PUT]:
-            k, v = pair.get("k"), pair.get("v")
-            if not isinstance(k, str) or not isinstance(v, str) or v == "":
-                continue
-            # Candidate encoding depends on the claim type (common.php:
-            # 874-898): bssid/ssid claims carry hex2bin'd PSKs, while
-            # 'hash' claims carry raw text (hc_unhex'd by the verifier) —
-            # a raw all-digit PSK must NOT be hex-decoded here.
-            if ctype in ("bssid", "ssid"):
-                try:
-                    psk = bytes.fromhex(v)
-                except ValueError:
-                    continue
-            else:
-                psk = oracle.hc_unhex(v)
-            for net in self._nets_for_claim(ctype, k):
-                self._try_accept(net, psk, submitter=data.get("ip", ""))
-        if hkey:
-            self.db.x("UPDATE n2d SET hkey = NULL WHERE hkey = ?", (hkey,))
+        with self._getwork_lock:
+            with self.db.tx():
+                for pair in cands[:MAX_CANDS_PER_PUT]:
+                    k, v = pair.get("k"), pair.get("v")
+                    if not isinstance(k, str) or not isinstance(v, str) or v == "":
+                        continue
+                    # Candidate encoding depends on the claim type (common.php:
+                    # 874-898): bssid/ssid claims carry hex2bin'd PSKs, while
+                    # 'hash' claims carry raw text (hc_unhex'd by the verifier) —
+                    # a raw all-digit PSK must NOT be hex-decoded here.
+                    if ctype in ("bssid", "ssid"):
+                        try:
+                            psk = bytes.fromhex(v)
+                        except ValueError:
+                            continue
+                    else:
+                        psk = oracle.hc_unhex(v)
+                    for net in self._nets_for_claim(ctype, k):
+                        self._try_accept(net, psk, submitter=data.get("ip", ""))
+                if hkey:
+                    self._release_lease(hkey, epoch)
         return True
+
+    def _release_lease(self, hkey: str, epoch: int = None) -> int:
+        """Release a live lease keyed by (hkey, epoch); returns released
+        row count (0 = stale holder / already released / reaped).  Legacy
+        clients send no epoch — it resolves from the live lease record,
+        which preserves the stale-holder guard (a reaped lease has no
+        live record to resolve)."""
+        with self.db.tx():
+            if epoch is None:
+                row = self.db.q1(
+                    "SELECT epoch FROM leases WHERE hkey = ? AND state = 0",
+                    (hkey,),
+                )
+                if row is None:
+                    return 0
+                epoch = row["epoch"]
+            cur = self.db.x(
+                """UPDATE leases SET state = 1, released = ?
+                   WHERE hkey = ? AND epoch = ? AND state = 0""",
+                (now(), hkey, epoch),
+            )
+            if cur.rowcount:
+                self.db.x(
+                    "UPDATE n2d SET hkey = NULL WHERE hkey = ? AND epoch = ?",
+                    (hkey, epoch),
+                )
+            return cur.rowcount
 
     def _nets_for_claim(self, ctype: str, key: str):
         if ctype == "bssid":
@@ -491,23 +718,29 @@ class ServerCore:
 
     def _mark_cracked(self, net_id: int, psk: bytes, pmk: bytes, nc: int, endian: str):
         # under the scheduler mutex: the n2d delete must not interleave
-        # with a get_work lease loop for the same net (see __init__)
+        # with a get_work lease loop for the same net (see __init__).
+        # Lock-ordering discipline everywhere: _getwork_lock FIRST, then
+        # tx() — never open a transaction and then take the scheduler
+        # mutex, or a concurrent get_work (lock held, waiting on the db
+        # lock) deadlocks against us.
         with self._getwork_lock:
-            self.db.x(
-                """UPDATE nets SET pass = ?, pmk = ?, nc = ?, endian = ?,
-                                  n_state = 1, ts = ? WHERE net_id = ?""",
-                (psk, pmk, nc, endian, now(), net_id),
-            )
-            self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
+            with self.db.tx():
+                self.db.x(
+                    """UPDATE nets SET pass = ?, pmk = ?, nc = ?, endian = ?,
+                                      n_state = 1, ts = ? WHERE net_id = ?""",
+                    (psk, pmk, nc, endian, now(), net_id),
+                )
+                self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
 
     def _delete_net(self, net_id: int):
         with self._getwork_lock:
-            row = self.db.q1("SELECT bssid FROM nets WHERE net_id = ?", (net_id,))
-            self.db.x("DELETE FROM nets WHERE net_id = ?", (net_id,))
-            if row and not self.db.q1(
-                "SELECT 1 FROM nets WHERE bssid = ? LIMIT 1", (row["bssid"],)
-            ):
-                self.db.x("DELETE FROM bssids WHERE bssid = ?", (row["bssid"],))
+            with self.db.tx():
+                row = self.db.q1("SELECT bssid FROM nets WHERE net_id = ?", (net_id,))
+                self.db.x("DELETE FROM nets WHERE net_id = ?", (net_id,))
+                if row and not self.db.q1(
+                    "SELECT 1 FROM nets WHERE bssid = ? LIMIT 1", (row["bssid"],)
+                ):
+                    self.db.x("DELETE FROM bssids WHERE bssid = ?", (row["bssid"],))
 
     # ------------------------------------------------------------------
     # Telemetry
@@ -536,6 +769,17 @@ class ServerCore:
                   ).set(max(0.0, now() - oldest) if oldest else 0.0)
         reg.gauge("dwpa_server_lease_reap_seconds",
                   "stale-lease reap threshold").set(LEASE_REAP_S)
+        reg.gauge("dwpa_server_leases_live",
+                  "live lease records (admission-control population)"
+                  ).set(self.db.q1(
+                      "SELECT COUNT(*) c FROM leases WHERE state = 0")["c"])
+        reg.gauge("dwpa_server_inflight_limit",
+                  "max live work-unit leases before get_work sheds (0 = "
+                  "uncapped)").set(self.max_inflight or 0)
+        reg.gauge("dwpa_server_work_queue_depth",
+                  "precomputed issuable targets awaiting pop (-1 = scan "
+                  "path, queue disabled)"
+                  ).set(len(self.queue) if self.queue is not None else -1)
         for state, label in ((0, "uncracked"), (1, "cracked")):
             reg.gauge("dwpa_server_nets",
                       "nets by crack state").labels(state=label).set(
@@ -566,18 +810,25 @@ class ServerCore:
         Mail delivery failures are swallowed like the reference's.
         """
         key = gen_key()
-        try:
-            self.db.x(
-                "INSERT INTO users(userkey, linkkey, linkkeyts, mail, ip) "
-                "VALUES (?, ?, ?, ?, ?)",
-                (key, key, now(), mail, ip),
-            )
-        except sqlite3.IntegrityError:
-            updated = self.db.x(
-                "UPDATE users SET linkkey = ?, linkkeyts = ? "
-                "WHERE mail = ? AND (linkkeyts IS NULL OR linkkeyts < ?)",
-                (key, now(), mail, now() - 24 * 3600),
-            ).rowcount
+        inserted, updated = True, 0
+        with self.db.tx():
+            # Both arms inside one tx (mail delivery stays outside it):
+            # the insert-or-rotate decision and the rotate itself commit
+            # together, never a rotated linkkey without its timestamp.
+            try:
+                self.db.x(
+                    "INSERT INTO users(userkey, linkkey, linkkeyts, mail, ip) "
+                    "VALUES (?, ?, ?, ?, ?)",
+                    (key, key, now(), mail, ip),
+                )
+            except sqlite3.IntegrityError:
+                inserted = False
+                updated = self.db.x(
+                    "UPDATE users SET linkkey = ?, linkkeyts = ? "
+                    "WHERE mail = ? AND (linkkeyts IS NULL OR linkkeyts < ?)",
+                    (key, now(), mail, now() - 24 * 3600),
+                ).rowcount
+        if not inserted:
             if updated != 1:
                 return ("throttled", None)
             if self.mailer:

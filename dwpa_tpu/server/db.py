@@ -17,6 +17,7 @@ translation:
   is ServerCore._getwork_lock.
 """
 
+import contextlib
 import sqlite3
 import threading
 import time
@@ -79,10 +80,26 @@ CREATE TABLE IF NOT EXISTS n2d (
     net_id INTEGER NOT NULL REFERENCES nets(net_id) ON DELETE CASCADE,
     d_id   INTEGER NOT NULL REFERENCES dicts(d_id),
     hkey   TEXT,                -- non-NULL = in-flight work unit lease
+    epoch  INTEGER NOT NULL DEFAULT 0,  -- lease generation (leases.epoch)
     ts     REAL NOT NULL DEFAULT (strftime('%s','now')),
     PRIMARY KEY (net_id, d_id)
 );
 CREATE INDEX IF NOT EXISTS idx_n2d_hkey ON n2d(hkey);
+
+-- First-class work-unit leases: one row per issued hkey, carrying a
+-- globally monotonic epoch (the lease generation).  Release and reap key
+-- on (hkey, epoch, state), so a reaped-then-reissued unit cannot be
+-- released or double-credited by the stale holder, and duplicate
+-- submits are idempotent (state only moves 0 -> 1|2 once).
+CREATE TABLE IF NOT EXISTS leases (
+    lease_id INTEGER PRIMARY KEY,
+    hkey     TEXT NOT NULL UNIQUE,
+    epoch    INTEGER NOT NULL,
+    issued   REAL NOT NULL DEFAULT (strftime('%s','now')),
+    state    INTEGER NOT NULL DEFAULT 0,  -- 0 live, 1 released, 2 reaped
+    released REAL
+);
+CREATE INDEX IF NOT EXISTS idx_leases_state ON leases(state, issued);
 
 CREATE TRIGGER IF NOT EXISTS trg_n2d_ins AFTER INSERT ON n2d BEGIN
     UPDATE nets  SET hits = hits + 1 WHERE net_id = NEW.net_id;
@@ -163,40 +180,96 @@ class Database:
     def __init__(self, path: str = ":memory:"):
         self.path = path
         self._lock = threading.RLock()
+        self._tx_depth = 0  # mutated only while holding _lock
         # 30 s busy wait (default is 5 s): an ops writer holding a
         # transaction for a few seconds — migration tooling, a manual
         # sqlite session, the jobs process mid-regen — must make API
         # writes wait, not 500 them (the reference's MySQL posture).
+        # isolation_level=None: sqlite3's implicit-BEGIN machinery is off;
+        # statements autocommit unless tx() has opened an explicit
+        # BEGIN IMMEDIATE, so transaction boundaries are exactly where
+        # the code says they are.
         self.conn = sqlite3.connect(path, check_same_thread=False,
-                                    timeout=30.0)
+                                    timeout=30.0, isolation_level=None)
         self.conn.row_factory = sqlite3.Row
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA foreign_keys=ON")
         self.conn.executescript(SCHEMA)
+        # Legacy databases predate the lease epoch column; CREATE TABLE
+        # IF NOT EXISTS won't touch their n2d, so migrate in place.
+        cols = [r[1] for r in self.conn.execute("PRAGMA table_info(n2d)")]
+        if "epoch" not in cols:
+            self.conn.execute(
+                "ALTER TABLE n2d ADD COLUMN epoch INTEGER NOT NULL DEFAULT 0")
         self.conn.executemany(
             "INSERT OR IGNORE INTO stats(name, value) VALUES (?, 0)",
             [(n,) for n in STAT_NAMES],
         )
-        self.conn.commit()
 
     def close(self):
         self.conn.close()
 
     # -- tiny helpers ------------------------------------------------------
 
+    def _exec(self, sql, params=()):
+        """Every statement — including tx()'s BEGIN/COMMIT — funnels
+        through this one call: the fault-injection seam the chaos
+        harness wraps (chaos/dbfault.py)."""
+        return self.conn.execute(sql, params)
+
     def q(self, sql, params=()):
         with self._lock:
-            return self.conn.execute(sql, params).fetchall()
+            return self._exec(sql, params).fetchall()
 
     def q1(self, sql, params=()):
         with self._lock:
-            return self.conn.execute(sql, params).fetchone()
+            return self._exec(sql, params).fetchone()
 
     def x(self, sql, params=()):
+        # Transaction-aware: inside an open tx() the statement joins the
+        # transaction and lands (or vanishes) with its COMMIT; outside,
+        # autocommit makes it durable immediately — same as before.
         with self._lock:
-            cur = self.conn.execute(sql, params)
-            self.conn.commit()
-            return cur
+            return self._exec(sql, params)
+
+    @contextlib.contextmanager
+    def tx(self):
+        """Explicit transaction seam: ``BEGIN IMMEDIATE`` .. COMMIT, or
+        ROLLBACK on any exception.  Reentrant: nested ``tx()`` blocks
+        join the outermost transaction (depth-counted), so helper
+        methods can declare their own atomicity and still compose into a
+        caller's larger transaction.  Holds the statement lock for the
+        whole block — within a process a transaction is exclusive, and
+        BEGIN IMMEDIATE serializes writers across processes.
+        """
+        with self._lock:
+            if self._tx_depth == 0:
+                self._exec("BEGIN IMMEDIATE")
+            self._tx_depth += 1
+            try:
+                yield self
+            except BaseException:
+                self._tx_depth -= 1
+                if self._tx_depth == 0:
+                    # A faulted/crashed connection may already be out of
+                    # its transaction — the rollback is best-effort, the
+                    # raise is not.
+                    try:
+                        self.conn.rollback()
+                    except sqlite3.Error:
+                        pass
+                raise
+            else:
+                self._tx_depth -= 1
+                if self._tx_depth == 0:
+                    try:
+                        self._exec("COMMIT")
+                    except BaseException:
+                        try:
+                            self.conn.rollback()
+                        except sqlite3.Error:
+                            pass
+                        raise
 
     def set_stat(self, name: str, value: int):
         self.x("INSERT OR REPLACE INTO stats(name, value) VALUES (?, ?)", (name, value))

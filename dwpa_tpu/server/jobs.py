@@ -26,7 +26,7 @@ from ..gen.psktool import psk_candidates
 from ..gen.vendors import vendor_candidates
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
-from .core import LEASE_REAP_S, SERVER_NC, ServerCore
+from .core import LEASE_REAP_S, LEASE_RETENTION_S, SERVER_NC, ServerCore
 from .db import long2mac
 
 
@@ -102,10 +102,33 @@ def _maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     # reference's ordering (maint.php computes its counters at 16-32 and
     # reaps at 36) — reaping first would drop just-expired work units out
     # of 24getwork/contributors for the hour they should still count.
-    reaped = db.x(
-        "UPDATE n2d SET hkey = NULL WHERE hkey IS NOT NULL AND ts < ?",
-        (time.time() - LEASE_REAP_S,),
-    ).rowcount
+    # One transaction under the scheduler mutex: the coverage-row clear,
+    # the lease-state flip (live -> reaped, which is what blocks the
+    # stale holder's later release) and the retention prune land
+    # together — a kill mid-reap never leaves a reaped lease whose n2d
+    # rows still look in-flight, or vice versa.
+    cutoff = time.time() - LEASE_REAP_S
+    with core._getwork_lock:
+        with db.tx():
+            reaped = db.x(
+                """UPDATE n2d SET hkey = NULL
+                   WHERE hkey IS NOT NULL
+                     AND (ts < ? OR hkey IN (SELECT hkey FROM leases
+                                             WHERE state = 0 AND issued < ?))""",
+                (cutoff, cutoff),
+            ).rowcount
+            db.x(
+                """UPDATE leases SET state = 2, released = ?
+                   WHERE state = 0 AND issued < ?""",
+                (time.time(), cutoff),
+            )
+            # bound the lease ledger: released/reaped records older than
+            # the retention window carry no audit value
+            db.x(
+                """DELETE FROM leases WHERE state != 0
+                   AND COALESCE(released, issued) < ?""",
+                (time.time() - LEASE_RETENTION_S,),
+            )
     if reaped > 0:
         core.registry.counter(
             "dwpa_server_leases_reaped_total",
@@ -226,29 +249,40 @@ def _keygen_precompute(core: ServerCore, limit, extra_generators) -> dict:
         cands += [("Pattern", c) for c in psk_candidates(h.essid, bssid)]
         for gen in extra_generators or []:
             cands += list(gen(bssid, h.essid))
-        hit_algo = ""
+        # Oracle verification first (pure compute, no locks held), then
+        # ONE transaction per net: the rkg attempt rows, the crack mark
+        # and the algo release commit together — a kill mid-net leaves
+        # it fully unprocessed (algo still NULL), never half-recorded.
+        tried, hit = [], None
         for algo, cand in cands:
-            db.x(
-                "INSERT INTO rkg(net_id, algo, pass) VALUES (?, ?, ?)",
-                (net["net_id"], algo, cand),
-            )
+            tried.append((algo, cand))
             r = oracle.check_key_m22000(h, [cand], nc=SERVER_NC)
             if r:
-                core._mark_cracked(
-                    net["net_id"], r[0], r[3], r[1] or 0, r[2] or ""
-                )
-                db.x(
-                    "UPDATE rkg SET n_state = 1 WHERE net_id = ? AND pass = ?",
-                    (net["net_id"], cand),
-                )
-                hit_algo = algo
-                found += 1
+                hit = (algo, cand, r)
                 break
-        # setting algo (even '') releases the net to the volunteers
-        db.x(
-            "UPDATE nets SET algo = ? WHERE net_id = ?",
-            (hit_algo, net["net_id"]),
-        )
+        hit_algo = hit[0] if hit else ""
+        with core._getwork_lock:
+            with db.tx():
+                for algo, cand in tried:
+                    db.x(
+                        "INSERT INTO rkg(net_id, algo, pass) VALUES (?, ?, ?)",
+                        (net["net_id"], algo, cand),
+                    )
+                if hit:
+                    _, cand, r = hit
+                    core._mark_cracked(
+                        net["net_id"], r[0], r[3], r[1] or 0, r[2] or ""
+                    )
+                    db.x(
+                        "UPDATE rkg SET n_state = 1 WHERE net_id = ? AND pass = ?",
+                        (net["net_id"], cand),
+                    )
+                    found += 1
+                # setting algo (even '') releases the net to the volunteers
+                db.x(
+                    "UPDATE nets SET algo = ? WHERE net_id = ?",
+                    (hit_algo, net["net_id"]),
+                )
     if found and core.dictdir:
         # any keygen hit regenerates the vendor-key dictionary so every
         # volunteer tries known default keys everywhere (rkg.php:178-197)
@@ -296,11 +330,12 @@ def psk_lookup(core: ServerCore, lookup, batch: int = 100) -> dict:
         core.put_work({"type": "bssid",
                        "cand": cand[i:i + MAX_CANDS_PER_PUT],
                        "ip": "psk_lookup"})
-    for r in rows:
-        core.db.x(
-            "UPDATE bssids SET flags = flags | 1 WHERE bssid = ?",
-            (r["bssid"],),
-        )
+    with core.db.tx():
+        for r in rows:
+            core.db.x(
+                "UPDATE bssids SET flags = flags | 1 WHERE bssid = ?",
+                (r["bssid"],),
+            )
     return {"queried": len(macs), "submitted": len(cand)}
 
 
@@ -317,18 +352,20 @@ def geolocate(core: ServerCore, lookup, batch: int = 5) -> int:
             info = lookup(long2mac(r["bssid"]))
         except LookupUnavailable:
             break  # transient outage: leave the rest unmarked for retry
-        if info:
-            core.db.x(
-                """UPDATE bssids SET lat = ?, lon = ?, country = ?,
-                        region = ?, city = ?, flags = flags | 2
-                   WHERE bssid = ?""",
-                (info.get("lat"), info.get("lon"), info.get("country"),
-                 info.get("region"), info.get("city"), r["bssid"]),
-            )
-        else:
-            core.db.x(
-                "UPDATE bssids SET flags = flags | 2 WHERE bssid = ?",
-                (r["bssid"],),
-            )
+        info = info or {}
+        # One statement covers both the hit and the not-found mark
+        # (COALESCE keeps existing values on a miss): each row's update
+        # is atomic on its own, and the lookup between rows means a
+        # wider transaction would just hold the write lock across
+        # network calls.
+        core.db.x(
+            """UPDATE bssids SET lat = COALESCE(?, lat),
+                    lon = COALESCE(?, lon), country = COALESCE(?, country),
+                    region = COALESCE(?, region), city = COALESCE(?, city),
+                    flags = flags | 2
+               WHERE bssid = ?""",
+            (info.get("lat"), info.get("lon"), info.get("country"),
+             info.get("region"), info.get("city"), r["bssid"]),
+        )
         done += 1
     return done

@@ -1264,6 +1264,76 @@ def test_baseline_write_load_round_trip(tmp_path):
     assert loaded[("DW103", "a.py", "x = 1")] == 1
 
 
+def test_dw114_flags_untransacted_multi_write():
+    """The seeded failure mode: two db.x writes whose combined effect
+    the caller assumed atomic — a crash between them tears the ledger."""
+    src = """
+        def accept(self, net_id):
+            self.db.x("UPDATE nets SET n_state = 1 WHERE net_id = ?",
+                      (net_id,))
+            self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
+    """
+    vs = lint(src, "dwpa_tpu/server/core.py")
+    assert codes(vs) == ["DW114"]
+    assert "Database.tx()" in vs[0].detail
+    # out of scope: the same shape outside the server package is clean
+    assert lint(src, "dwpa_tpu/client/main.py") == []
+    assert lint(src, "bench.py") == []
+
+
+def test_dw114_tx_wrapped_and_single_site_stay_clean():
+    """The compliant idioms: the same sequence under ``with db.tx():``,
+    and a SINGLE write site even when looped (per-row autocommit around
+    network calls — the geolocate pattern)."""
+    assert lint("""
+        def accept(self, net_id):
+            with self.db.tx():
+                self.db.x("UPDATE nets SET n_state = 1 WHERE net_id = ?",
+                          (net_id,))
+                self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
+
+        def geolocate(db, rows, lookup):
+            for r in rows:
+                info = lookup(r)
+                db.x("UPDATE bssids SET lat = ? WHERE bssid = ?",
+                     (info, r))
+    """, "dwpa_tpu/server/jobs.py") == []
+    # a bare function using module-level db, two sites -> still flagged
+    assert codes(lint("""
+        def fixup(db):
+            db.x("UPDATE a SET x = 1")
+            db.x("UPDATE b SET y = 2")
+    """, "dwpa_tpu/server/tools.py")) == ["DW114"]
+
+
+def test_dw114_nested_scopes_counted_separately():
+    """An inner helper's single write must not inflate the enclosing
+    function's count: each def is its own atomicity domain."""
+    assert lint("""
+        def outer(self):
+            self.db.x("UPDATE a SET x = 1")
+
+            def inner():
+                self.db.x("UPDATE b SET y = 2")
+            return inner
+    """, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw114_real_server_tree_is_clean():
+    """The refactored server package carries no untransacted
+    multi-statement write paths (the PR's whole point)."""
+    import os
+
+    root = repo_root()
+    server = os.path.join(root, "dwpa_tpu", "server")
+    for name in sorted(os.listdir(server)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(server, name), encoding="utf-8") as f:
+            vs = lint_source(f.read(), f"dwpa_tpu/server/{name}")
+        assert [v for v in vs if v.code == "DW114"] == [], name
+
+
 def test_full_tree_clean_under_checked_in_baseline():
     """The acceptance gate: ``python -m dwpa_tpu.analysis`` exits 0 on
     this tree with the checked-in baseline — every hot-path sync is
@@ -1275,8 +1345,8 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
-             "DW108", "DW109", "DW111", "DW112", "DW113", "DW201", "DW202",
-             "DW203", "DW204"}
+             "DW108", "DW109", "DW111", "DW112", "DW113", "DW114", "DW201",
+             "DW202", "DW203", "DW204"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

