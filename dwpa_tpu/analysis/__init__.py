@@ -8,9 +8,17 @@ see (the round-5 advisor findings were all of this species):
   bench timings).  Rule codes DW10x.
 - :mod:`.contracts` — static cross-layer diff of the client protocol
   fields vs the server handlers vs the sqlite schema.  Codes DW20x.
+- :mod:`.concurrency` — whole-program lock-order / shared-state /
+  thread-confinement analysis over the package call graph (deadlock
+  schedules, unguarded cross-thread writes, hold-and-wait, sqlite
+  handles escaping the funnel).  Codes DW30x.
 - :mod:`.recompile` — runtime recompilation sentinel (context manager
   + pytest fixture) that counts XLA compile-cache misses and fails a
   sweep that recompiles per batch.
+- :mod:`.lockwatch` — runtime lock-order witness: instrumented
+  Lock/RLock wrappers record the actual acquisition-order graph during
+  a test and fail at teardown if it has a cycle (the dynamic half of
+  DW301, wired into the chaos soaks).
 
 Run standalone with ``python -m dwpa_tpu.analysis`` (exit 0 = clean
 under the checked-in baseline); tier-1 runs the same pass via
@@ -19,19 +27,24 @@ rule-code interpretation and the baseline-update workflow.
 """
 
 import os
+import time
 
 from .baseline import (DEFAULT_BASELINE, apply_baseline, load_baseline,
                        write_baseline)
+from .concurrency import check_concurrency
 from .contracts import check_contracts
 from .linter import Violation, lint_source, lint_tree
+from .lockwatch import (LockOrderError, LockWitness, watch_locks,
+                        witness_report)
 from .recompile import (CompileReport, RecompilationError, no_recompiles,
                         watch_compiles)
 
 __all__ = [
     "Violation", "lint_source", "lint_tree", "check_contracts",
-    "watch_compiles", "no_recompiles", "RecompilationError",
-    "CompileReport", "load_baseline", "apply_baseline", "write_baseline",
-    "DEFAULT_BASELINE", "repo_root", "run_analysis",
+    "check_concurrency", "watch_compiles", "no_recompiles",
+    "RecompilationError", "CompileReport", "LockOrderError", "LockWitness",
+    "watch_locks", "witness_report", "load_baseline", "apply_baseline",
+    "write_baseline", "DEFAULT_BASELINE", "repo_root", "run_analysis",
 ]
 
 
@@ -41,15 +54,24 @@ def repo_root() -> str:
         os.path.abspath(__file__))))
 
 
-def collect_violations(root: str = None) -> list:
-    """Full pass: lint every source file + the cross-layer contracts."""
+def collect_violations(root: str = None, timings: dict = None) -> list:
+    """Full pass: lint every source file + the cross-layer contracts +
+    the whole-program concurrency analysis.  ``timings`` (when a dict is
+    passed) gains per-pass/per-rule wall-clock seconds."""
     root = root or repo_root()
+    t0 = time.perf_counter()
     violations = lint_tree(root)
+    if timings is not None:
+        timings["lint"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
     try:
         violations += check_contracts(root)
     except FileNotFoundError:
         # a partial tree (e.g. a fixture dir) has no protocol layers
         pass
+    if timings is not None:
+        timings["contracts"] = time.perf_counter() - t0
+    violations += check_concurrency(root, timings=timings)
     return violations
 
 
@@ -58,7 +80,9 @@ def run_analysis(root: str = None, baseline_path: str = None,
     """The CLI/test entry point.  Returns a process exit code:
     0 = clean under the baseline, 1 = new violations."""
     root = root or repo_root()
-    violations = collect_violations(root)
+    timings = {}
+    violations = collect_violations(root, timings=timings)
+    timed = " ".join(f"{k}={v:.2f}s" for k, v in timings.items())
     if update_baseline:
         path = write_baseline(violations, baseline_path)
         log(f"baseline updated: {len(violations)} accepted violation(s) "
@@ -76,7 +100,7 @@ def run_analysis(root: str = None, baseline_path: str = None,
         for code, path, snippet in stale:
             log(f"  {code} {path}: {snippet}")
     if new:
-        log(f"FAILED: {len(new)} new violation(s)")
+        log(f"FAILED: {len(new)} new violation(s) [{timed}]")
         return 1
-    log(f"OK: {len(violations)} violation(s), all baselined")
+    log(f"OK: {len(violations)} violation(s), all baselined [{timed}]")
     return 0

@@ -2,20 +2,55 @@
 
 Exit codes: 0 = tree is clean under the checked-in baseline,
 1 = new violations (printed one per line as ``path:line: CODE msg``).
-See INSTALL.md ("Static analysis") for the rule-code reference.
+``--explain DWnnn`` prints one rule's documentation and (for the DW3xx
+concurrency family) a runnable example.  The summary line carries
+per-pass/per-rule wall-clock so a slow rule is visible the day it
+regresses.  See INSTALL.md ("Static analysis") for the rule-code
+reference.
 """
 
 import argparse
+import re
 import sys
 
 from . import DEFAULT_BASELINE, repo_root, run_analysis
+from .concurrency import EXAMPLES
+
+
+def _rule_doc(code: str) -> str:
+    """The docstring bullet for ``code`` out of the rule modules."""
+    from . import concurrency, contracts, linter
+
+    for mod in (linter, contracts, concurrency):
+        doc = mod.__doc__ or ""
+        m = re.search(
+            rf"^- \*\*{code}[^\n]*\n(?:(?!^- \*\*|^[^ \n]).*\n?)*",
+            doc, re.M)
+        if m:
+            return m.group(0).rstrip()
+    return ""
+
+
+def explain(code: str, log=print) -> int:
+    code = code.upper()
+    doc = _rule_doc(code)
+    if not doc:
+        log(f"unknown rule {code!r} — rules are documented in "
+            "analysis/linter.py (DW1xx), analysis/contracts.py (DW2xx) "
+            "and analysis/concurrency.py (DW3xx)")
+        return 2
+    log(doc)
+    if code in EXAMPLES:
+        log("\nExample:\n" + EXAMPLES[code])
+    return 0
 
 
 def build_parser():
     p = argparse.ArgumentParser(
         prog="dwpa_tpu.analysis",
         description="repo-native JAX contract linter + cross-layer "
-                    "protocol/schema drift checker",
+                    "protocol/schema drift checker + whole-program "
+                    "concurrency analysis",
     )
     p.add_argument("root", nargs="?", default=None,
                    help="tree to analyze (default: the repo this package "
@@ -26,11 +61,16 @@ def build_parser():
                    help="accept the current violation set as the new "
                         "baseline (use when a flagged line is reviewed "
                         "and intentional)")
+    p.add_argument("--explain", metavar="DWnnn", default=None,
+                   help="print one rule's documentation (+ example for "
+                        "the DW3xx concurrency rules) and exit")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.explain:
+        return explain(args.explain)
     return run_analysis(root=args.root or repo_root(),
                         baseline_path=args.baseline,
                         update_baseline=args.update_baseline)

@@ -14,6 +14,12 @@ around a file never churn the baseline, while editing the offending
 line itself forces an explicit re-accept.  Duplicate identical lines
 are handled by multiplicity: an entry absorbs at most ``count``
 matching violations.
+
+Each entry may carry a ``why`` — the one-line justification for
+accepting it (JSON has no comments, so the rationale lives in the
+entry itself).  ``--update-baseline`` preserves the ``why`` of every
+surviving entry, so a re-ratchet never silently drops the reasoning;
+new entries land with an empty ``why`` to be filled in by the author.
 """
 
 import json
@@ -57,9 +63,24 @@ def apply_baseline(violations, baseline: dict):
     return new, absorbed, stale
 
 
-def write_baseline(violations, path: str = None):
-    """Serialize the current violation set as the new baseline."""
+def load_whys(path: str = None) -> dict:
+    """{(code, path, snippet): why} for entries carrying a rationale."""
     path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {
+        (e["code"], e["path"], e["snippet"]): e["why"]
+        for e in data.get("violations", []) if e.get("why")
+    }
+
+
+def write_baseline(violations, path: str = None):
+    """Serialize the current violation set as the new baseline,
+    carrying over the ``why`` of every entry that survives."""
+    path = path or DEFAULT_BASELINE
+    whys = load_whys(path)
     counts = {}
     lines = {}
     for v in violations:
@@ -68,7 +89,8 @@ def write_baseline(violations, path: str = None):
         lines.setdefault(key, v.line)
     entries = [
         {"code": code, "path": p, "snippet": snip, "count": n,
-         "line_hint": lines[(code, p, snip)]}
+         "line_hint": lines[(code, p, snip)],
+         "why": whys.get((code, p, snip), "")}
         for (code, p, snip), n in sorted(counts.items())
     ]
     with open(path, "w", encoding="utf-8") as f:

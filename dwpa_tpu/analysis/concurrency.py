@@ -1,0 +1,859 @@
+"""Whole-program concurrency analysis: lock order, shared state, thread
+confinement (rule family DW3xx).
+
+The reference dwpa serializes everything behind one global SHM lock
+(get_work.php:49); this port is genuinely concurrent — feed producers,
+per-device stream workers, the executor's unit producer, the server's
+queue materializer and cron thread, plus every WSGI request thread.  The
+per-function linter (DW1xx) cannot see a deadlock: a lock-order
+inversion needs the *call graph* (who holds what when calling whom).
+This pass builds that graph over the package AST — module-level, no
+imports executed — and checks four hazards:
+
+- **DW301 lock-order-inversion** — a cycle in the static
+  lock-acquisition-order graph.  Nodes are lock identities (a
+  ``threading.Lock/RLock/Condition/Semaphore`` assignment site,
+  canonicalized as ``Class.attr`` / module-global name, plus the
+  synthetic ``Database.tx`` node for ``with db.tx():`` blocks); an edge
+  A→B means some thread can acquire B while holding A, found by
+  propagating the held-lock set through the call graph.  A cycle is a
+  deadlock schedule: two threads entering the cycle from different
+  edges block each other forever.  The canonical repo order is
+  ``_getwork_lock`` FIRST, then ``tx()`` (server/core.py) — any path
+  taking them in reverse is exactly the PR-12 hand-fixed bug this rule
+  exists to catch.  Reentrant self-edges (RLock) are ignored.
+- **DW302 unguarded-shared-write** — a module global or ``self.``
+  attribute written from ≥2 thread roots with no common guarding lock.
+  A thread root is every resolved ``threading.Thread(target=...)``
+  plus the synthetic *main* root (externally-callable functions).  A
+  write's guard set is the locks lexically held at the write plus the
+  locks every caller provably holds around the call (must-intersection
+  over call sites).  ``__init__`` writes are exempt (``Thread.start()``
+  is a happens-before barrier), as are lock/thread-valued attributes.
+- **DW303 blocking-while-locked** — a blocking call (``queue.get`` /
+  ``<thread>.join`` / ``<lock>.acquire`` / ``<cv>.wait`` without a
+  timeout) made while holding a lock: hold-and-wait, half of a
+  deadlock, and a liveness cliff even alone (every sibling of that
+  lock stalls behind an unbounded wait).  A ``Condition.wait`` whose
+  receiver is itself the held lock is exempt — waiting releases it
+  (the feed's backpressure wait); any *other* lock still held flags.
+- **DW304 db-handle-escape** — a raw sqlite connection (``*.conn``)
+  dereference, or a private ``Database`` method call (``db._exec``
+  style), reachable from ≥2 thread roots outside the ``_exec``/``tx()``
+  funnel in server/db.py.  Every cross-thread statement must go
+  through the funnel: it is the single serialization point (one RLock)
+  and the chaos harness's fault-injection seam — a handle that escapes
+  it bypasses both, and sqlite check_same_thread=False makes the race
+  silent until a torn write.
+
+Heuristics and their bias: lock identity is canonicalized by defining
+class + attribute name; an attribute assigned a lock in more than one
+class merges into a wildcard ``*.attr`` node (guard matching treats the
+wildcard as compatible with any class's attr — biased against false
+DW302 positives).  Call resolution is name-based with a deny list of
+ubiquitous method names and a fan-out cap, biased toward missing exotic
+dispatch rather than drowning the baseline.  The runtime half of this
+family (:mod:`.lockwatch`) witnesses the *actual* acquisition order
+under the chaos soaks, covering what the static pass abstracts away.
+"""
+
+import ast
+import dataclasses
+import os
+import re
+import time
+
+from .linter import Violation, _line
+
+#: threading constructors whose assignment defines a lock identity
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+#: of those, the ones whose .wait() releases the lock itself
+CONDITION_CTORS = {"Condition"}
+
+#: blocking-sync methods DW303 polices when called without a timeout
+BLOCKING_METHODS = {"get", "join", "acquire", "wait"}
+#: receiver-name pattern marking a call as queue/lock/thread-primitive
+#: (same shape as the linter's DW107 receiver gate)
+_BLOCKING_RECV = re.compile(
+    r"(?i)(queue|lock|sem|cond|cv|event|thread|feeder|worker|producer"
+    r"|^q$|_q$|^t$)")
+
+#: attribute names DW302 never treats as shared data (synchronization
+#: objects and thread handles are written once and used via their API)
+_SYNC_ATTR = re.compile(
+    r"(?i)(lock|mutex|sem$|semaphore|cond|_cv$|event|thread|_tl$)")
+
+#: mutating container methods DW302 counts as writes to the receiver
+MUTATOR_METHODS = {"append", "extend", "add", "update", "insert", "remove",
+                   "discard", "clear", "pop", "popleft", "appendleft",
+                   "setdefault", "push", "push_many"}
+
+#: method names too ubiquitous to resolve by name across the package
+_NO_RESOLVE = {"get", "put", "pop", "append", "add", "update", "close",
+               "items", "keys", "values", "join", "split", "strip", "read",
+               "write", "open", "run", "start", "set", "clear", "copy",
+               "encode", "decode", "hex", "acquire", "release", "wait",
+               "notify", "notify_all", "sleep", "now", "info", "debug",
+               "warning", "error", "exception", "q", "q1", "x", "send"}
+#: resolution fan-out cap: a simple name mapping to more distinct
+#: functions than this is too ambiguous to follow
+_MAX_FANOUT = 4
+
+#: the public Database API (server/db.py) a handle may cross threads on
+DB_PUBLIC_API = {"q", "q1", "x", "tx", "close", "path"}
+#: methods of Database itself allowed to touch self.conn (the funnel)
+DB_FUNNEL_METHODS = {"__init__", "_exec", "close", "tx"}
+#: receiver names DW304 treats as a Database handle
+_DB_RECV = re.compile(r"(?i)(^db$|^_db$|_db$|^database$|^conn$)")
+
+#: runnable --explain examples for the DW3xx rules
+EXAMPLES = {
+    "DW301": """\
+# BAD: two threads, opposite acquisition order -> deadlock schedule
+def refill(self):                     # thread A
+    with self.db.tx():                # tx() first ...
+        with self._getwork_lock:      # ... then the scheduler mutex
+            ...
+def get_work(self):                   # thread B (canonical order)
+    with self._getwork_lock:          # scheduler mutex FIRST,
+        with self.db.tx():            # then tx() -- every path must agree
+            ...""",
+    "DW302": """\
+# BAD: producer and consumer threads both write self.stats bare
+def _produce(self):                   # thread root 1
+    self.stats["fed"] += 1
+def _collect(self):                   # thread root 2
+    self.stats["done"] += 1
+# GOOD: a common guard (or confine writes to one thread)
+def _produce(self):
+    with self._lock:
+        self.stats["fed"] += 1""",
+    "DW303": """\
+# BAD: unbounded blocking call while holding a lock (hold-and-wait)
+with self._lock:
+    item = self.work_queue.get()      # stalls every sibling of _lock
+# GOOD: bound the wait, or drop the lock first
+with self._lock:
+    item = self.work_queue.get(timeout=5.0)""",
+    "DW304": """\
+# BAD: raw sqlite handle used off the funnel from a worker thread
+def _drain(self):                     # thread root
+    self.db.conn.execute("DELETE FROM leases")   # bypasses Database._lock
+# GOOD: cross threads only through the funnel
+def _drain(self):
+    self.db.x("DELETE FROM leases")   # serialized + chaos-injectable""",
+}
+
+
+# ---------------------------------------------------------------------------
+# module collection
+# ---------------------------------------------------------------------------
+
+
+def _walk_py(root):
+    """Yield (relpath, source) for the same file set lint_tree covers."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not d.startswith(".") and d not in (
+                "__pycache__", "tests", "build", "dist"))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full, encoding="utf-8") as f:
+                    yield rel, f.read()
+
+
+@dataclasses.dataclass
+class _Func:
+    qname: str          # "path::Class.name" / "path::name" / nested "a.b"
+    path: str           # repo-relative posix path
+    cls: str            # enclosing class name or ""
+    name: str           # bare function name
+    node: object        # the ast.FunctionDef
+    src_lines: list
+    parent: str = ""    # enclosing function qname (nested defs)
+    # analysis outputs (filled by _analyze_body)
+    acq: set = dataclasses.field(default_factory=set)
+    edges: dict = dataclasses.field(default_factory=dict)
+    calls: list = dataclasses.field(default_factory=list)
+    blocking: list = dataclasses.field(default_factory=list)
+    writes: list = dataclasses.field(default_factory=list)
+    conn_uses: list = dataclasses.field(default_factory=list)
+    spawns: list = dataclasses.field(default_factory=list)
+    local_locks: dict = dataclasses.field(default_factory=dict)
+
+
+class _Program:
+    """The package-wide index: functions, locks, and name tables."""
+
+    def __init__(self):
+        self.funcs = {}            # qname -> _Func
+        self.by_name = {}          # bare name -> [qname]
+        self.by_cls = {}           # (path, cls, name) -> qname
+        self.by_mod = {}           # (path, name) -> qname (module level)
+        self.attr_locks = {}       # attr -> {"Cls.attr", ...}
+        self.mod_locks = {}        # (path, name) -> "path:name"
+        self.cond_ids = set()      # lock ids built from Condition()
+        self.mod_globals = set()   # (path, name) mutable module globals
+
+    def lock_classes(self, attr):
+        return self.attr_locks.get(attr, set())
+
+
+def _is_lock_ctor(value):
+    """The lock constructor name if ``value``'s subtree builds a
+    threading primitive (covers shard-lock list comprehensions)."""
+    for node in ast.walk(value):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in LOCK_CTORS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "threading"):
+            return f.attr
+        if isinstance(f, ast.Name) and f.id in LOCK_CTORS:
+            return f.id
+    return None
+
+
+def build_program(root) -> "_Program":
+    prog = _Program()
+    for rel, src in _walk_py(root):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # DW100 is the linter's business
+        src_lines = src.splitlines()
+        _index_module(prog, rel, tree, src_lines)
+    return prog
+
+
+def _index_module(prog, rel, tree, src_lines):
+    def add_func(node, cls, parent):
+        qname = (f"{rel}::{cls}.{node.name}" if cls
+                 else (f"{parent}.{node.name}" if parent
+                       else f"{rel}::{node.name}"))
+        fn = _Func(qname, rel, cls, node.name, node, src_lines,
+                   parent=parent)
+        prog.funcs[qname] = fn
+        prog.by_name.setdefault(node.name, []).append(qname)
+        if cls:
+            prog.by_cls[(rel, cls, node.name)] = qname
+        elif not parent:
+            prog.by_mod[(rel, node.name)] = qname
+        for child in node.body:
+            index_stmt(child, cls="", parent=qname)
+
+    def index_stmt(node, cls, parent):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add_func(node, cls, parent)
+        elif isinstance(node, ast.ClassDef):
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    add_func(child, node.name, "")
+            _index_class_locks(prog, node)
+        elif isinstance(node, ast.Assign) and not parent and not cls:
+            ctor = _is_lock_ctor(node.value)
+            if ctor:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lid = f"{rel}:{t.id}"
+                        prog.mod_locks[(rel, t.id)] = lid
+                        if ctor in CONDITION_CTORS:
+                            prog.cond_ids.add(lid)
+
+    for node in tree.body:
+        index_stmt(node, cls="", parent="")
+
+
+def _index_class_locks(prog, cls_node):
+    for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        ctor = _is_lock_ctor(node.value)
+        if not ctor:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                lid = f"{cls_node.name}.{t.attr}"
+                prog.attr_locks.setdefault(t.attr, set()).add(lid)
+                if ctor in CONDITION_CTORS:
+                    prog.cond_ids.add(lid)
+
+
+# ---------------------------------------------------------------------------
+# per-function analysis
+# ---------------------------------------------------------------------------
+
+
+def _recv_root(expr):
+    """Innermost Name of an attribute/subscript chain, or None."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _resolve_lock(prog, fn, expr):
+    """Lock identity for an acquisition-site expression, or None."""
+    if isinstance(expr, ast.Subscript):      # self._locks[i] (shard lists)
+        return _resolve_lock(prog, fn, expr.value)
+    if isinstance(expr, ast.Name):
+        if expr.id in fn.local_locks:
+            return fn.local_locks[expr.id]
+        return prog.mod_locks.get((fn.path, expr.id))
+    if isinstance(expr, ast.Attribute):
+        attr = expr.attr
+        owners = prog.lock_classes(attr)
+        if (isinstance(expr.value, ast.Name) and expr.value.id == "self"
+                and fn.cls and f"{fn.cls}.{attr}" in owners):
+            return f"{fn.cls}.{attr}"
+        if len(owners) == 1:
+            return next(iter(owners))
+        if len(owners) > 1:
+            return f"*.{attr}"       # ambiguous: wildcard-merged identity
+    return None
+
+
+def _tx_lock(expr):
+    """The synthetic Database.tx lock for ``with X.tx():`` items."""
+    if (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "tx" and not expr.args):
+        return "Database.tx"
+    return None
+
+
+def _has_timeout(call, method):
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if method in ("join", "wait") and call.args:
+        return True                       # join(10) / wait(0.5)
+    if method in ("get", "acquire") and len(call.args) >= 2:
+        return True                       # get(block, timeout)
+    return False
+
+
+def _analyze_body(prog, fn):
+    """Walk one function body tracking the lexically held lock set;
+    fill the function's acq/edges/calls/blocking/writes/conn/spawns."""
+    src = fn.src_lines
+
+    def note_edge(held, lid, node):
+        fn.acq.add(lid)
+        if lid in held:
+            return          # reentrant re-acquisition orders nothing
+        for h in held:
+            if h != lid:
+                fn.edges.setdefault(
+                    (h, lid), (fn.path, node.lineno, _line(src, node)))
+
+    def walk_expr(node, held):
+        for call in _own_calls(node):
+            handle_call(call, held)
+
+    def handle_call(call, held):
+        f = call.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        # thread spawns
+        if name == "Thread":
+            target = next((kw.value for kw in call.keywords
+                           if kw.arg == "target"), None)
+            if target is not None:
+                fn.spawns.append((target, call.lineno))
+        # blocking-sync sites (DW303 raw material)
+        if isinstance(f, ast.Attribute) and name in BLOCKING_METHODS:
+            recv_lock = _resolve_lock(prog, fn, f.value)
+            recv_name = (f.value.attr if isinstance(f.value, ast.Attribute)
+                         else _recv_root(f.value) or "")
+            if ((recv_lock or _BLOCKING_RECV.search(recv_name or ""))
+                    and not _has_timeout(call, name)):
+                fn.blocking.append((name, recv_lock, frozenset(held),
+                                    call.lineno, _line(src, call)))
+        # explicit lock.acquire() also orders locks
+        if isinstance(f, ast.Attribute) and name == "acquire":
+            lid = _resolve_lock(prog, fn, f.value)
+            if lid:
+                note_edge(held, lid, call)
+        # mutating container methods = writes (DW302 raw material)
+        if (isinstance(f, ast.Attribute) and name in MUTATOR_METHODS
+                and isinstance(f.value, (ast.Attribute, ast.Subscript,
+                                         ast.Name))):
+            note_write_target(f.value, call, held)
+        # db-handle escapes (DW304 raw material)
+        if (isinstance(f, ast.Attribute) and name.startswith("_")
+                and name not in DB_FUNNEL_METHODS
+                and isinstance(f.value, (ast.Name, ast.Attribute))):
+            recv = (f.value.attr if isinstance(f.value, ast.Attribute)
+                    else f.value.id)
+            if _DB_RECV.search(recv or "") and recv != "conn":
+                fn.conn_uses.append(("private call", call.lineno,
+                                     _line(src, call)))
+        # call-graph site
+        callees = _resolve_call(prog, fn, call)
+        if callees:
+            fn.calls.append((callees, frozenset(held), call.lineno,
+                             _line(src, call)))
+
+    def note_write_target(target, node, held):
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and fn.cls):
+            if _SYNC_ATTR.search(base.attr):
+                return
+            fn.writes.append((f"{fn.cls}.{base.attr}", frozenset(held),
+                              node.lineno, _line(src, node)))
+        elif isinstance(base, ast.Name):
+            if (fn.path, base.id) in prog.mod_globals:
+                fn.writes.append((f"{fn.path}:{base.id}", frozenset(held),
+                                  node.lineno, _line(src, node)))
+
+    def walk_block(stmts, held):
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes analyzed on their own
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = list(held)
+                for item in stmt.items:
+                    lid = (_resolve_lock(prog, fn, item.context_expr)
+                           or _tx_lock(item.context_expr))
+                    walk_expr(item.context_expr, inner)
+                    if lid:
+                        note_edge(inner, lid, item.context_expr)
+                        inner.append(lid)
+                walk_block(stmt.body, inner)
+                continue
+            # local lock definitions
+            if isinstance(stmt, ast.Assign):
+                ctor = _is_lock_ctor(stmt.value)
+                if ctor:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            lid = f"{fn.qname}:{t.id}"
+                            fn.local_locks[t.id] = lid
+                            if ctor in CONDITION_CTORS:
+                                prog.cond_ids.add(lid)
+            # explicit acquire/release pairs widen/narrow the held set
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Attribute)):
+                m = stmt.value.func.attr
+                lid = _resolve_lock(prog, fn, stmt.value.func.value)
+                if lid and m == "acquire":
+                    walk_expr(stmt, held)
+                    note_edge(held, lid, stmt.value)
+                    held.append(lid)
+                    continue
+                if lid and m == "release" and lid in held:
+                    held.remove(lid)
+                    continue
+            # assignment targets = writes
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    for el in ast.walk(t):
+                        if isinstance(el, (ast.Attribute, ast.Name)):
+                            note_write_target(el, stmt, held)
+                            break
+            walk_expr(stmt, held)
+            for child_block in _sub_blocks(stmt):
+                walk_block(child_block, held)
+
+    walk_block(fn.node.body, [])
+
+
+def _own_calls(node):
+    """Call nodes in ``node``'s own expressions — does NOT descend into
+    nested statement blocks (walk_block recurses into those itself, so
+    descending here would record every nested site twice) nor into
+    nested ``def`` bodies (analyzed as their own functions)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            yield n
+        for field, value in ast.iter_fields(n):
+            if isinstance(n, ast.stmt) and field in (
+                    "body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.AST):
+                stack.append(value)
+            elif isinstance(value, list):
+                stack.extend(v for v in value if isinstance(v, ast.AST))
+
+
+def _sub_blocks(stmt):
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if block:
+            yield block
+    for handler in getattr(stmt, "handlers", []) or []:
+        yield handler.body
+
+
+def _resolve_call(prog, fn, call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        # nested def in the enclosing function chain wins
+        scope = fn.qname
+        while scope:
+            q = f"{scope}.{f.id}"
+            if q in prog.funcs:
+                return [q]
+            scope = prog.funcs[scope].parent if scope in prog.funcs else ""
+        q = prog.by_mod.get((fn.path, f.id))
+        if q:
+            return [q]
+        cands = [c for c in prog.by_name.get(f.id, ())
+                 if not prog.funcs[c].cls]
+        return cands if 0 < len(cands) <= _MAX_FANOUT else []
+    if isinstance(f, ast.Attribute):
+        name = f.attr
+        if isinstance(f.value, ast.Name) and f.value.id == "self" and fn.cls:
+            q = prog.by_cls.get((fn.path, fn.cls, name))
+            if q:
+                return [q]
+        if name in _NO_RESOLVE:
+            return []
+        cands = prog.by_name.get(name, ())
+        return list(cands) if 0 < len(cands) <= _MAX_FANOUT else []
+    return []
+
+
+def _resolve_target(prog, fn, target):
+    """A Thread(target=...) expression -> function qname, or None."""
+    if isinstance(target, ast.Name):
+        r = _resolve_call(prog, fn, ast.Call(
+            func=ast.Name(id=target.id, ctx=ast.Load()), args=[],
+            keywords=[]))
+        return r[0] if r else None
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self" and fn.cls):
+        return prog.by_cls.get((fn.path, fn.cls, target.attr))
+    if isinstance(target, ast.Attribute):
+        cands = prog.by_name.get(target.attr, ())
+        return cands[0] if len(cands) == 1 else None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# whole-program propagation
+# ---------------------------------------------------------------------------
+
+
+def _collect_globals(prog, root):
+    """Module-level mutable globals (non-lock, non-constant targets):
+    the names DW302 tracks writes to."""
+    prog.mod_globals = set()
+    for rel, src in _walk_py(root):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Name) and not t.id.isupper()
+                            and not _is_lock_ctor(node.value)
+                            and not _SYNC_ATTR.search(t.id)):
+                        prog.mod_globals.add((rel, t.id))
+
+
+def _fixpoint_acq(prog):
+    """acq*(f) = locks f may acquire, transitively."""
+    star = {q: set(fn.acq) for q, fn in prog.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, fn in prog.funcs.items():
+            for callees, _, _, _ in fn.calls:
+                for c in callees:
+                    extra = star.get(c, set()) - star[q]
+                    if extra:
+                        star[q] |= extra
+                        changed = True
+    return star
+
+
+def _entry_held(prog):
+    """Per-function caller-held sets: may (union) and must
+    (intersection) over every call site, propagated to fixpoint."""
+    callers = {}          # callee -> [(caller, held)]
+    for q, fn in prog.funcs.items():
+        for callees, held, _, _ in fn.calls:
+            for c in callees:
+                callers.setdefault(c, []).append((q, held))
+    may = {q: set() for q in prog.funcs}
+    must = {q: None for q in prog.funcs}     # None = unconstrained (top)
+    for _ in range(len(prog.funcs)):
+        changed = False
+        for q in prog.funcs:
+            sites = callers.get(q)
+            if not sites:
+                if must[q] is None:
+                    must[q] = set()
+                continue
+            new_may = set()
+            new_must = None
+            for caller, held in sites:
+                site_held = set(held) | may[caller]
+                new_may |= site_held
+                site_must = set(held) | (must[caller] or set())
+                new_must = (site_must if new_must is None
+                            else new_must & site_must)
+            if new_may != may[q] or new_must != (must[q] or set()):
+                may[q], must[q] = new_may, new_must
+                changed = True
+        if not changed:
+            break
+    return may, {q: (m or set()) for q, m in must.items()}
+
+
+def _thread_roots(prog):
+    """{root label: reachable qname set}; spawned targets plus the
+    synthetic 'main' root (uncalled, unspawned functions = the API)."""
+    callees_of = {q: set() for q in prog.funcs}
+    called = set()
+    for q, fn in prog.funcs.items():
+        for cs, _, _, _ in fn.calls:
+            callees_of[q] |= set(cs)
+            called |= set(cs)
+
+    def reach(seeds):
+        seen, stack = set(seeds), list(seeds)
+        while stack:
+            q = stack.pop()
+            for c in callees_of.get(q, ()):
+                if c not in seen:
+                    seen.add(c)
+                    stack.append(c)
+        return seen
+
+    roots = {}
+    spawn_targets = set()
+    for q, fn in prog.funcs.items():
+        for target, _ in fn.spawns:
+            t = _resolve_target(prog, fn, target)
+            if t:
+                spawn_targets.add(t)
+                roots[f"thread:{t}"] = None
+    for label in list(roots):
+        roots[label] = reach([label.split(":", 1)[1]])
+    main_entries = [q for q in prog.funcs
+                    if q not in called and q not in spawn_targets]
+    roots["main"] = reach(main_entries)
+    return roots
+
+
+def _guard_compatible(guard, held):
+    """True if ``held`` contains ``guard`` or its wildcard twin."""
+    if guard in held:
+        return True
+    attr = guard.split(".", 1)[-1]
+    return any(h == f"*.{attr}" or (guard.startswith("*.")
+                                    and h.split(".", 1)[-1] == attr)
+               for h in held)
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+
+def _check_dw301(prog, acq_star, out):
+    edges = {}                          # (a, b) -> witness
+    for q, fn in prog.funcs.items():
+        for e, w in fn.edges.items():
+            edges.setdefault(e, w)
+        for callees, held, lineno, snippet in fn.calls:
+            for c in callees:
+                for lid in acq_star.get(c, ()):
+                    if lid in held:
+                        continue    # reentrant re-acquire: orders nothing
+                    for h in held:
+                        if h != lid:
+                            edges.setdefault((h, lid),
+                                             (fn.path, lineno, snippet))
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    seen_cycles = set()
+    for start in sorted(graph):
+        stack, on_path = [(start, iter(sorted(graph.get(start, ()))))], [start]
+        visited = set()
+        while stack:
+            node, it = stack[-1]
+            nxt = next(it, None)
+            if nxt is None:
+                stack.pop()
+                on_path.pop()
+                continue
+            if nxt == start and len(on_path) > 1:
+                cyc = tuple(on_path)
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    _emit_cycle(edges, cyc, out)
+                continue
+            if nxt in on_path or nxt in visited:
+                continue
+            visited.add(nxt)
+            on_path.append(nxt)
+            stack.append((nxt, iter(sorted(graph.get(nxt, ())))))
+
+
+def _emit_cycle(edges, cyc, out):
+    ring = list(cyc) + [cyc[0]]
+    legs = []
+    witness = None
+    for a, b in zip(ring, ring[1:]):
+        w = edges.get((a, b))
+        if w:
+            legs.append(f"{a}->{b} at {w[0]}:{w[1]}")
+            witness = witness or w
+    if witness is None:                   # pragma: no cover - edges exist
+        return
+    path, line, snippet = witness
+    out.append(Violation(
+        "DW301", path, line,
+        "lock-order inversion: acquisition-order cycle "
+        + " -> ".join(list(cyc) + [cyc[0]]) + " ("
+        + "; ".join(legs) + ") — two threads entering from different "
+        "edges deadlock; make every path agree on one order",
+        snippet))
+
+
+def _check_dw302(prog, entry_must, roots, out):
+    roots_of = {}
+    for label, reach in roots.items():
+        for q in reach:
+            roots_of.setdefault(q, set()).add(label)
+    groups = {}          # shared key -> [(qname, guards, line, snippet)]
+    for q, fn in prog.funcs.items():
+        if fn.name == "__init__":
+            continue     # happens-before Thread.start()
+        for key, held, lineno, snippet in fn.writes:
+            guards = set(held) | entry_must.get(q, set())
+            groups.setdefault(key, []).append((q, guards, lineno, snippet))
+    for key, sites in sorted(groups.items()):
+        writer_roots = set()
+        for q, _, _, _ in sites:
+            writer_roots |= roots_of.get(q, set())
+        if len(writer_roots) < 2:
+            continue
+        all_guards = set().union(*(g for _, g, _, _ in sites))
+        if any(all(_guard_compatible(g, guards)
+                   for _, guards, _, _ in sites) for g in all_guards):
+            continue
+        q, guards, lineno, snippet = next(
+            (s for s in sites if not s[1]), sites[0])
+        fn = prog.funcs[q]
+        out.append(Violation(
+            "DW302", fn.path, lineno,
+            f"shared state {key!r} written from {len(writer_roots)} thread "
+            f"roots ({', '.join(sorted(writer_roots))}) without a common "
+            "guarding lock — guard every write site with one lock or "
+            "confine writes to a single thread",
+            snippet))
+
+
+def _check_dw303(prog, entry_may, out):
+    for q, fn in prog.funcs.items():
+        for method, recv_lock, held, lineno, snippet in fn.blocking:
+            effective = set(held) | entry_may.get(q, set())
+            if recv_lock:
+                # waiting on / re-acquiring the lock you hold releases
+                # or reenters it (Condition.wait, reentrant RLock)
+                effective.discard(recv_lock)
+                if recv_lock.startswith("*."):
+                    attr = recv_lock[2:]
+                    effective = {h for h in effective
+                                 if h.split(".", 1)[-1] != attr}
+            if effective:
+                out.append(Violation(
+                    "DW303", fn.path, lineno,
+                    f"blocking .{method}() with no timeout while holding "
+                    f"{sorted(effective)} — hold-and-wait stalls every "
+                    "sibling of the held lock (and is half a deadlock); "
+                    "bound the wait or release the lock first",
+                    snippet))
+
+
+def _check_dw304(prog, roots, out):
+    roots_of = {}
+    for label, reach in roots.items():
+        for q in reach:
+            roots_of.setdefault(q, set()).add(label)
+    for q, fn in prog.funcs.items():
+        uses = list(fn.conn_uses)
+        for node in ast.walk(fn.node):
+            if (isinstance(node, ast.Attribute) and node.attr == "conn"
+                    and isinstance(node.value, (ast.Name, ast.Attribute))):
+                recv = (node.value.id if isinstance(node.value, ast.Name)
+                        else node.value.attr)
+                if recv == "self" and fn.cls:
+                    recv = fn.cls.lower()
+                if _DB_RECV.search(recv or ""):
+                    uses.append(("raw .conn access", node.lineno,
+                                 _line(fn.src_lines, node)))
+        if not uses:
+            continue
+        if (fn.path.endswith("server/db.py")
+                and fn.name in DB_FUNNEL_METHODS):
+            continue                      # the funnel itself
+        if len(roots_of.get(q, set())) < 2:
+            continue                      # confined to one thread root
+        for what, lineno, snippet in uses:
+            out.append(Violation(
+                "DW304", fn.path, lineno,
+                f"sqlite handle crosses thread roots "
+                f"({', '.join(sorted(roots_of[q]))}) via {what} outside "
+                "the Database._exec/tx() funnel — route every cross-"
+                "thread statement through db.q/q1/x/tx so one RLock "
+                "serializes it (and chaos faults can reach it)",
+                snippet))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def check_concurrency(root: str, timings: dict = None) -> list:
+    """Run DW301–DW304 against the tree at ``root``.  Returns a list of
+    linter.Violation; fills ``timings`` (rule code -> seconds) when a
+    dict is passed."""
+    t0 = time.perf_counter()
+    prog = build_program(root)
+    _collect_globals(prog, root)
+    for fn in prog.funcs.values():
+        _analyze_body(prog, fn)
+    acq_star = _fixpoint_acq(prog)
+    entry_may, entry_must = _entry_held(prog)
+    roots = _thread_roots(prog)
+    if timings is not None:
+        timings["graph"] = time.perf_counter() - t0
+
+    out = []
+    for code, check, args in (
+            ("DW301", _check_dw301, (prog, acq_star)),
+            ("DW302", _check_dw302, (prog, entry_must, roots)),
+            ("DW303", _check_dw303, (prog, entry_may)),
+            ("DW304", _check_dw304, (prog, roots))):
+        t1 = time.perf_counter()
+        check(*args, out)
+        if timings is not None:
+            timings[code] = time.perf_counter() - t1
+    return out

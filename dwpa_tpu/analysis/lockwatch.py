@@ -1,0 +1,320 @@
+"""Runtime lock-order witness: the dynamic half of DW301.
+
+The static pass (:mod:`.concurrency`) proves lock-order acyclicity over
+an *abstraction* of the program; this module witnesses the real thing.
+``watch_locks()`` patches ``threading.Lock``/``threading.RLock`` for the
+duration of a block, so every lock **created inside the window** (the
+chaos soaks construct their cores, clients, queues and feeds inside it)
+records which locks its acquiring thread already held.  Those
+observations form the acquisition-order witness graph; at exit the
+witness asserts the graph is acyclic and names the offending edges —
+mirroring the :mod:`.recompile` sentinel's shape: a context manager that
+fails loudly at teardown, plus a pytest fixture
+(:mod:`.pytest_plugin` ``lock_witness``).
+
+What is and isn't recorded:
+
+- an acquisition while other locks are held adds one edge per held
+  lock (held → acquired);
+- reentrant RLock acquisitions (depth > 1) record nothing — reentry
+  orders nothing;
+- ``Condition`` waits work unmodified: the wrapper implements the
+  ``_release_save``/``_acquire_restore``/``_is_owned`` protocol, and
+  the re-acquisition after a wait IS recorded (it is a real
+  acquisition, and a real deadlock schedule if ordered against a held
+  lock);
+- lock names default to their creation site (``file.py:lineno``) so a
+  violation names real code, not ``object at 0x...``.
+
+A cycle in the witness graph means the run actually exhibited every
+edge of a deadlock schedule — only the interleaving saved it.  That is
+a bug whether or not the run hung, which is why the chaos soaks assert
+it on every seed.
+"""
+
+import os
+import sys
+import threading
+
+_REAL_LOCK = threading.Lock          # bound at import: patch-proof
+_REAL_RLOCK = threading.RLock
+
+
+class LockOrderError(AssertionError):
+    """Raised when the witnessed acquisition-order graph has a cycle."""
+
+
+def _creation_site(skip_module):
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename
+        if os.path.basename(fname) != skip_module:
+            return f"{os.path.basename(fname)}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"                # pragma: no cover - always has frames
+
+
+class LockWitness:
+    """Thread-aware acquisition-order recorder shared by every watched
+    lock of one ``watch_locks`` window."""
+
+    def __init__(self, label: str = ""):
+        self.label = label
+        self._mu = _REAL_LOCK()       # guards edges/counter (real lock:
+        self._edges = {}              # never watches itself)
+        self._tls = threading.local()
+        self._n = 0
+
+    # -- naming ------------------------------------------------------------
+
+    def next_name(self, kind: str) -> str:
+        with self._mu:
+            self._n += 1
+            n = self._n
+        return f"{kind}-{n}@{_creation_site('lockwatch.py')}"
+
+    # -- recording ---------------------------------------------------------
+
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def record_acquire(self, name: str):
+        held = self._held()
+        if held:
+            thread = threading.current_thread().name
+            with self._mu:
+                for h in held:
+                    if h != name:
+                        self._edges.setdefault((h, name), thread)
+        held.append(name)
+
+    def record_release(self, name: str):
+        held = self._held()
+        if name in held:
+            # remove the most recent acquisition (LIFO is the common
+            # case; out-of-order release still just drops one entry)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == name:
+                    del held[i]
+                    break
+
+    # -- reporting / verdict -----------------------------------------------
+
+    @property
+    def edges(self) -> dict:
+        """{(held, acquired): acquiring-thread-name} snapshot."""
+        with self._mu:
+            return dict(self._edges)
+
+    def find_cycle(self):
+        """One acquisition-order cycle as [n1, n2, ..., n1], or None."""
+        edges = self.edges
+        graph = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in graph}
+        parent = {}
+
+        def dfs(n):
+            color[n] = GRAY
+            for m in sorted(graph.get(n, ())):
+                if color.get(m, WHITE) == WHITE:
+                    parent[m] = n
+                    found = dfs(m)
+                    if found:
+                        return found
+                elif color.get(m) == GRAY:
+                    cyc = [m, n]
+                    cur = n
+                    while cur != m:
+                        cur = parent[cur]
+                        cyc.append(cur)
+                    cyc.reverse()
+                    return cyc
+            color[n] = BLACK
+            return None
+
+        for n in sorted(graph):
+            if color.get(n, WHITE) == WHITE:
+                found = dfs(n)
+                if found:
+                    return found
+        return None
+
+    def check(self):
+        """Raise LockOrderError if the witness graph has a cycle."""
+        cyc = self.find_cycle()
+        if cyc is None:
+            return
+        edges = self.edges
+        legs = []
+        for a, b in zip(cyc, cyc[1:]):
+            legs.append(f"  {a} -> {b} (thread {edges.get((a, b), '?')})")
+        label = f" [{self.label}]" if self.label else ""
+        raise LockOrderError(
+            f"lock acquisition-order cycle witnessed{label}:\n"
+            + "\n".join(legs)
+            + "\nevery edge of this deadlock schedule really executed — "
+            "only the interleaving saved this run (static twin: DW301)")
+
+
+def witness_report(witness: LockWitness) -> str:
+    """Human-readable witness-graph dump (for debugging a violation)."""
+    edges = witness.edges
+    if not edges:
+        return "lockwatch: no ordered acquisitions witnessed"
+    lines = [f"lockwatch: {len(edges)} ordered acquisition edge(s)"]
+    for (a, b), thread in sorted(edges.items()):
+        lines.append(f"  {a} -> {b}  [first witnessed on {thread}]")
+    return "\n".join(lines)
+
+
+class WatchedLock:
+    """Drop-in ``threading.Lock`` that reports to a LockWitness."""
+
+    _KIND = "Lock"
+
+    def __init__(self, witness: LockWitness, name: str = None):
+        self._witness = witness
+        self.name = name or witness.next_name(self._KIND)
+        self._inner = self._make_inner()
+
+    def _make_inner(self):
+        return _REAL_LOCK()
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness.record_acquire(self.name)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        self._witness.record_release(self.name)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class WatchedRLock(WatchedLock):
+    """Drop-in ``threading.RLock``: reentrant, Condition-compatible.
+
+    Reentrant acquisitions (depth > 1) record no edges; the depth is
+    tracked per-owner exactly like the real RLock.  The
+    ``_release_save``/``_acquire_restore``/``_is_owned`` trio lets a
+    ``threading.Condition`` built over this lock wait correctly while
+    the witness's held-stack stays truthful across the wait.
+    """
+
+    _KIND = "RLock"
+
+    def __init__(self, witness, name=None):
+        super().__init__(witness, name)
+        self._owner = None
+        self._depth = 0       # mutated only by the owning thread
+
+    def _make_inner(self):
+        return _REAL_RLOCK()
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = threading.get_ident()
+        reentry = self._owner == me
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._owner = me
+            self._depth += 1
+            if not reentry:
+                self._witness.record_acquire(self.name)
+        return ok
+
+    def release(self):
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._depth -= 1
+        last = self._depth == 0
+        if last:
+            self._owner = None
+        self._inner.release()
+        if last:
+            self._witness.record_release(self.name)
+
+    # -- Condition protocol ------------------------------------------------
+
+    def _is_owned(self):
+        return self._owner == threading.get_ident()
+
+    def _release_save(self):
+        """Full release for Condition.wait: unwind the depth, pop the
+        witness stack (waiting really relinquishes the lock)."""
+        depth = self._depth
+        self._depth = 0
+        self._owner = None
+        state = self._inner._release_save()
+        self._witness.record_release(self.name)
+        return (depth, state)
+
+    def _acquire_restore(self, saved):
+        depth, state = saved
+        self._inner._acquire_restore(state)
+        self._owner = threading.get_ident()
+        self._depth = depth
+        # the re-acquisition after a wait is a real ordering event
+        self._witness.record_acquire(self.name)
+
+    def locked(self):
+        return self._owner is not None
+
+
+class watch_locks:
+    """Context manager: patch ``threading.Lock``/``RLock`` so locks
+    created inside the window report to a fresh witness; assert the
+    witness graph is acyclic on clean exit (mirrors
+    ``recompile.no_recompiles``)::
+
+        with watch_locks(label="chaos soak") as witness:
+            core = ServerCore(Database(":memory:"))   # locks watched
+            ...
+        # exiting raises LockOrderError on an acquisition-order cycle
+
+    On an exceptional exit the original exception propagates unmasked
+    (the witness is still queryable for post-mortems).  Not reentrant —
+    one window at a time per process.
+    """
+
+    def __init__(self, label: str = ""):
+        self.witness = LockWitness(label)
+
+    def __enter__(self):
+        self._saved = (threading.Lock, threading.RLock)
+        witness = self.witness
+
+        def make_lock(*a, **k):
+            return WatchedLock(witness)
+
+        def make_rlock(*a, **k):
+            return WatchedRLock(witness)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        return witness
+
+    def __exit__(self, exc_type, exc, tb):
+        threading.Lock, threading.RLock = self._saved
+        if exc_type is None:
+            self.witness.check()
+        return False

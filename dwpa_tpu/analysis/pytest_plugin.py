@@ -19,6 +19,7 @@ imports pytest outside test runs.
 
 import pytest
 
+from .lockwatch import watch_locks
 from .recompile import no_recompiles
 
 
@@ -27,3 +28,18 @@ def recompile_sentinel():
     """Factory fixture: ``recompile_sentinel(allowed=0, label="")``
     returns the fail-on-exit context manager (see recompile.no_recompiles)."""
     return no_recompiles
+
+
+@pytest.fixture
+def lock_witness():
+    """Factory fixture: ``lock_witness(label="")`` returns the
+    lock-order witness context manager (see lockwatch.watch_locks) —
+    locks created inside the block record their acquisition order, and
+    exit raises LockOrderError on a witnessed cycle::
+
+        def test_soak_deadlock_free(lock_witness):
+            with lock_witness(label="storm") as witness:
+                core = ServerCore(Database(":memory:"))
+                ...  # every lock the soak creates is watched
+    """
+    return watch_locks

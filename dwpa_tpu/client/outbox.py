@@ -23,13 +23,19 @@ dict cache:
 ``drain`` stops at the first transport failure (the server is down — the
 next drain retries) but keeps going past per-key rejections.
 
-Single-writer by design: the submitting crack loop owns the journal.
+The submitting crack loop owns the journal in today's wiring, but the
+mutators (``record``/``ack``/``close``) and the replay all run under one
+mutex anyway: the journal survives power loss, so it should not be
+undone by a background drain thread interleaving ``_append`` frames or
+double-creating the file — thread-safety is part of the durability
+story, not an optimization (concurrency rule DW302).
 """
 
 import binascii
 import json
 import os
 import struct
+import threading
 
 from ..utils.fsio import fsync_dir, fsync_replace
 
@@ -70,6 +76,10 @@ class FoundOutbox:
     def __init__(self, dirpath: str, registry=None):
         os.makedirs(dirpath, exist_ok=True)
         self.path = os.path.join(dirpath, JOURNAL_NAME)
+        # One mutex over state + journal handle: record/ack interleaved
+        # from two threads must never tear a frame or double-create the
+        # file (module doc).
+        self._mu = threading.Lock()
         # (hkey, k) -> v, insertion-ordered: drain submits in the order
         # founds were journaled.
         self._pending = {}
@@ -109,17 +119,18 @@ class FoundOutbox:
             return
         good_end = len(FILE_MAGIC)
         frames = 0
-        for record, off in _walk_frames(blob):
-            good_end = off
-            frames += 1
-            op = record.get("op")
-            key = (record.get("hkey"), record.get("k"))
-            if op == "found":
-                if key not in self._acked:
-                    self._pending[key] = record.get("v")  # latest wins
-            elif op == "ack":
-                self._acked.add(key)
-                self._pending.pop(key, None)
+        with self._mu:
+            for record, off in _walk_frames(blob):
+                good_end = off
+                frames += 1
+                op = record.get("op")
+                key = (record.get("hkey"), record.get("k"))
+                if op == "found":
+                    if key not in self._acked:
+                        self._pending[key] = record.get("v")  # latest wins
+                elif op == "ack":
+                    self._acked.add(key)
+                    self._pending.pop(key, None)
         live = len(self._pending) + len(self._acked)
         if good_end < len(blob) or frames > 2 * live:
             # Torn tail, or mostly superseded/duplicate frames: rewrite
@@ -139,6 +150,8 @@ class FoundOutbox:
         fsync_replace(tmp, self.path)
 
     def _append(self, records: list):
+        # Caller holds ``_mu``: the lazy create and the frame writes
+        # below must not interleave across threads.
         created = self._f is None
         if created:
             self._f = open(self.path, "w+b")
@@ -162,40 +175,44 @@ class FoundOutbox:
         has them; re-sending is the duplicate this outbox exists to
         prevent)."""
         fresh = []
-        for c in cand:
-            key = (hkey, c["k"])
-            if key in self._acked:
-                continue
-            if self._pending.get(key) == c["v"]:
-                fresh.append(c)  # already journaled, still needs sending
-                continue
-            self._pending[key] = c["v"]
-            fresh.append(c)
-            self._append([{"op": "found", "hkey": hkey,
-                           "k": c["k"], "v": c["v"]}])
-            if self._m_pending is not None:
-                self._m_pending.inc()
+        with self._mu:
+            for c in cand:
+                key = (hkey, c["k"])
+                if key in self._acked:
+                    continue
+                if self._pending.get(key) == c["v"]:
+                    fresh.append(c)  # already journaled, still needs sending
+                    continue
+                self._pending[key] = c["v"]
+                fresh.append(c)
+                self._append([{"op": "found", "hkey": hkey,
+                               "k": c["k"], "v": c["v"]}])
+                if self._m_pending is not None:
+                    self._m_pending.inc()
         return fresh
 
     def ack(self, hkey: str, cand: list):
         """Mark founds as accepted by the server.  Idempotent."""
         acks = []
-        for c in cand:
-            key = (hkey, c["k"])
-            if key in self._acked:
-                continue
-            self._acked.add(key)
-            self._pending.pop(key, None)
-            acks.append({"op": "ack", "hkey": hkey, "k": c["k"]})
-            if self._m_acked is not None:
-                self._m_acked.inc()
-        if acks:
-            self._append(acks)
+        with self._mu:
+            for c in cand:
+                key = (hkey, c["k"])
+                if key in self._acked:
+                    continue
+                self._acked.add(key)
+                self._pending.pop(key, None)
+                acks.append({"op": "ack", "hkey": hkey, "k": c["k"]})
+                if self._m_acked is not None:
+                    self._m_acked.inc()
+            if acks:
+                self._append(acks)
 
     def pending(self) -> dict:
         """``{hkey: [{"k":…, "v":…}, …]}`` in journaled order."""
         out = {}
-        for (hkey, k), v in self._pending.items():
+        with self._mu:
+            items = list(self._pending.items())
+        for (hkey, k), v in items:
             out.setdefault(hkey, []).append({"k": k, "v": v})
         return out
 
@@ -218,12 +235,14 @@ class FoundOutbox:
         return delivered
 
     def pending_count(self) -> int:
-        return len(self._pending)
+        with self._mu:
+            return len(self._pending)
 
     def close(self):
-        if self._f is not None:
-            self._f.flush()
-            os.fsync(self._f.fileno())
-            self._f.close()
-            self._f = None
-            fsync_dir(os.path.dirname(os.path.abspath(self.path)))
+        with self._mu:
+            if self._f is not None:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._f.close()
+                self._f = None
+                fsync_dir(os.path.dirname(os.path.abspath(self.path)))

@@ -147,7 +147,10 @@ class PMKStore:
     def _load(self):
         """Scan every ESSID dir, mmap intact segments, index their
         records.  Torn tails (bad magic/length/CRC) stop the frame walk
-        for that segment — the prefix keeps serving."""
+        for that segment — the prefix keeps serving.  Runs under the
+        store lock like every other index mutation: the load is
+        init-time today, but the index guard invariant (rule DW302) is
+        cheaper to keep than to reason away."""
         found = []
         for name in sorted(os.listdir(self.root)):
             edir = os.path.join(self.root, name)
@@ -162,9 +165,10 @@ class PMKStore:
                 if m:
                     found.append((int(m.group(2)), essid,
                                   os.path.join(edir, fn)))
-        for seq, essid, path in sorted(found):
-            self._seq = max(self._seq, seq + 1)
-            self._load_segment(seq, essid, path)
+        with self._lock:
+            for seq, essid, path in sorted(found):
+                self._seq = max(self._seq, seq + 1)
+                self._load_segment(seq, essid, path)
         self._m_bytes.set(self._total_bytes())
 
     def _load_segment(self, seq: int, essid: bytes, path: str):

@@ -138,32 +138,45 @@ def cmd_serve(args):
     port = args.port if args.port is not None else 8080
     with make_server(host, port, app,
                      server_class=ThreadingWSGIServer) as srv:
-        _start_materializer(serve_core)
+        mat = _start_materializer(serve_core)
         print(f"dwpa_tpu server on http://{host}:{port}/", flush=True)
-        srv.serve_forever()
+        try:
+            srv.serve_forever()
+        finally:
+            if mat is not None:
+                thread, stop = mat
+                stop.set()
+                thread.join(timeout=5.0)
 
 
 def _start_materializer(core, interval: float = 1.0):
     """Background issuable-queue refill for ``serve``: keeps get_work on
     the O(1) pop path instead of the inline refill scan.  No-op when the
-    queue is disabled (--no-work-queue)."""
+    queue is disabled (--no-work-queue).
+
+    Returns ``(thread, stop)`` or None; setting ``stop`` ends the loop
+    within one tick and the thread can then be joined — the thread-
+    lifecycle rule every spawn in this repo follows (daemon=True is the
+    backstop for serve_forever's hard exit, not the shutdown story)."""
     import threading
 
     if core.queue is None:
         return None
 
+    stop = threading.Event()
+
     def loop():
-        while True:
+        while not stop.is_set():
             try:
                 core.materialize_queue()
             except Exception:
                 pass  # transient sqlite contention: next tick retries
-            time.sleep(interval)
+            stop.wait(interval)
 
     t = threading.Thread(target=loop, daemon=True,
                          name="dwpa-queue-materializer")
     t.start()
-    return t
+    return t, stop
 
 
 def _geo_lookup_from_file(path):

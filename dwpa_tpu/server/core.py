@@ -105,14 +105,14 @@ class WorkQueue:
         group): a sibling hint left behind would out-rank a never-tried
         net on the next pop, diverging from the scan's min-hits order."""
         drop = set(items)
-        for i, lock in enumerate(self._locks):
-            with lock:
+        for i in range(len(self._shards)):
+            with self._locks[i]:
                 self._shards[i] = [x for x in self._shards[i]
                                    if x not in drop]
 
     def clear(self):
-        for i, lock in enumerate(self._locks):
-            with lock:
+        for i in range(len(self._shards)):
+            with self._locks[i]:
                 self._shards[i].clear()
 
 

@@ -36,7 +36,8 @@ enable_compilation_cache(
 # Recompilation sentinel (dwpa_tpu.analysis): guards steady-state sweeps
 # against per-batch XLA recompiles.  Imported AFTER the platform setup
 # above — the plugin pulls in jax.
-from dwpa_tpu.analysis.pytest_plugin import recompile_sentinel  # noqa: E402,F401
+from dwpa_tpu.analysis.pytest_plugin import (  # noqa: E402,F401
+    lock_witness, recompile_sentinel)
 
 
 def pytest_configure(config):

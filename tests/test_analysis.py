@@ -17,10 +17,11 @@ import jax
 import jax.numpy as jnp
 
 from dwpa_tpu.analysis import (
-    RecompilationError, apply_baseline, check_contracts, collect_violations,
-    lint_source, load_baseline, no_recompiles, repo_root, run_analysis,
-    watch_compiles, write_baseline,
+    RecompilationError, apply_baseline, check_concurrency, check_contracts,
+    collect_violations, lint_source, load_baseline, no_recompiles, repo_root,
+    run_analysis, watch_compiles, write_baseline,
 )
+from dwpa_tpu.analysis.baseline import load_whys
 
 OPS_PATH = "dwpa_tpu/ops/seeded.py"
 HOT_PATH = "dwpa_tpu/models/m22000.py"
@@ -1346,7 +1347,7 @@ def test_full_tree_clean_under_checked_in_baseline():
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
              "DW108", "DW109", "DW111", "DW112", "DW113", "DW114", "DW201",
-             "DW202", "DW203", "DW204"}
+             "DW202", "DW203", "DW204", "DW301", "DW302", "DW303", "DW304"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known
@@ -1368,3 +1369,367 @@ def test_cli_exits_nonzero_on_new_violation(tmp_path):
     assert cli_main([root, "--baseline", str(empty),
                      "--update-baseline"]) == 0
     assert cli_main([root, "--baseline", str(empty)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# DW301-DW304: whole-program concurrency analysis
+# ---------------------------------------------------------------------------
+
+
+def _conc_tree(tmp_path, src, rel="dwpa_tpu/svc.py"):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _conc(tmp_path, src, rel="dwpa_tpu/svc.py"):
+    return check_concurrency(_conc_tree(tmp_path, src, rel))
+
+
+def test_dw301_lock_order_inversion(tmp_path):
+    vs = _conc(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert codes(vs) == ["DW301"]
+    assert "S._a" in vs[0].detail and "S._b" in vs[0].detail
+
+
+def test_dw301_consistent_order_is_clean(tmp_path):
+    assert _conc(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """) == []
+
+
+def test_dw301_inversion_through_a_call(tmp_path):
+    """The interprocedural half: no single function inverts, the pair
+    of call chains does."""
+    vs = _conc(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def take_b(self):
+                with self._b:
+                    pass
+
+            def one(self):
+                with self._a:
+                    self.take_b()
+
+            def take_a(self):
+                with self._a:
+                    pass
+
+            def two(self):
+                with self._b:
+                    self.take_a()
+    """)
+    assert codes(vs) == ["DW301"]
+
+
+def test_dw301_reentrant_nesting_is_not_an_inversion(tmp_path):
+    """The core.py accept-path shape: a callee re-enters an RLock its
+    caller already holds.  Re-acquisition of a held lock orders
+    nothing — flagging it would invert put_work's real hierarchy."""
+    assert _conc(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.RLock()
+                self._b = threading.RLock()
+
+            def inner(self):
+                with self._a:      # reentrant: caller holds _a
+                    pass
+
+            def outer(self):
+                with self._a:
+                    with self._b:
+                        self.inner()
+    """) == []
+
+
+def test_dw302_unguarded_cross_thread_write(tmp_path):
+    vs = _conc(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.items = []
+
+            def start(self):
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                self.items.append(1)
+
+            def add(self, x):
+                self.items.append(x)
+    """)
+    assert codes(vs) == ["DW302"]
+    assert "W.items" in vs[0].detail
+
+
+def test_dw302_common_guard_is_clean(tmp_path):
+    assert _conc(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.items = []
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                with self._lock:
+                    self.items.append(1)
+
+            def add(self, x):
+                with self._lock:
+                    self.items.append(x)
+    """) == []
+
+
+def test_dw302_single_thread_writes_are_clean(tmp_path):
+    """No spawned root ever writes: confinement needs no lock."""
+    assert _conc(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+
+            def also(self, x):
+                self.items.extend(x)
+    """) == []
+
+
+def test_dw302_guard_propagates_through_private_callee(tmp_path):
+    """A callee whose every caller holds the lock inherits the guard
+    (entry must-hold): the FoundOutbox._append shape."""
+    assert _conc(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.items = []
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._worker).start()
+
+            def _push(self, x):
+                self.items.append(x)
+
+            def _worker(self):
+                with self._lock:
+                    self._push(1)
+
+            def add(self, x):
+                with self._lock:
+                    self._push(x)
+    """) == []
+
+
+def test_dw303_blocking_get_while_holding_lock(tmp_path):
+    vs = _conc(tmp_path, """
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def pump(self):
+                with self._lock:
+                    return self._q.get()
+    """)
+    assert codes(vs) == ["DW303"]
+    assert "C._lock" in vs[0].detail
+
+
+def test_dw303_bounded_wait_and_unlocked_get_are_clean(tmp_path):
+    assert _conc(tmp_path, """
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bounded(self):
+                with self._lock:
+                    return self._q.get(timeout=1.0)
+
+            def unlocked(self):
+                return self._q.get()
+    """) == []
+
+
+def test_dw303_condition_wait_on_own_lock_is_clean(tmp_path):
+    """cv.wait() releases the lock it waits on: holding only the
+    condition's own lock is the idiom, not a hazard."""
+    assert _conc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait()
+    """) == []
+
+
+def test_dw304_raw_conn_crossing_thread_roots(tmp_path):
+    vs = _conc(tmp_path, """
+        import threading
+
+        class Core:
+            def __init__(self, db):
+                self.db = db
+
+            def start(self):
+                threading.Thread(target=self._tick).start()
+
+            def _tick(self):
+                self._touch()
+
+            def _touch(self):
+                self.db.conn.execute("SELECT 1")
+
+            def hits(self):
+                self._touch()
+    """)
+    assert codes(vs) == ["DW304"]
+    assert "conn" in vs[0].detail
+
+
+def test_dw304_funneled_db_api_is_clean(tmp_path):
+    assert _conc(tmp_path, """
+        import threading
+
+        class Core:
+            def __init__(self, db):
+                self.db = db
+
+            def start(self):
+                threading.Thread(target=self._tick).start()
+
+            def _tick(self):
+                self._touch()
+
+            def _touch(self):
+                self.db.x("UPDATE nets SET hits = hits + 1")
+
+            def hits(self):
+                self._touch()
+    """) == []
+
+
+def test_dw304_single_root_conn_is_clean(tmp_path):
+    """A raw handle confined to one thread root stays legal (the db
+    module itself, CLI one-shots)."""
+    assert _conc(tmp_path, """
+        class Tool:
+            def __init__(self, db):
+                self.db = db
+
+            def dump(self):
+                return self.db.conn.execute("SELECT 1")
+    """) == []
+
+
+def test_concurrency_real_tree_only_baselined_findings():
+    """The live tree's DW3xx findings are exactly the triaged set in
+    the checked-in baseline (each entry carries its ``why``)."""
+    vs = [v for v in check_concurrency(repo_root())
+          if v.code.startswith("DW3")]
+    new, absorbed, stale = apply_baseline(vs, load_baseline())
+    assert [v.render() for v in new] == []
+    whys = load_whys()
+    missing = [v.fingerprint() for v in absorbed
+               if not whys.get(v.fingerprint())]
+    assert missing == [], "baselined DW3xx entries must carry a why"
+
+
+def test_baseline_why_survives_update(tmp_path):
+    """--update-baseline rewrites entries but must carry over the
+    justification of every surviving entry."""
+    path = str(tmp_path / "baseline.json")
+    write_baseline([_viol(), _viol(code="DW103")], path)
+    data = json.loads(open(path).read())
+    for e in data["violations"]:
+        assert e["why"] == ""
+        if e["code"] == "DW104":
+            e["why"] = "intentional hits-gate sync"
+    with open(path, "w") as f:
+        json.dump(data, f)
+    write_baseline([_viol()], path)   # DW103 fixed, DW104 survives
+    data2 = json.loads(open(path).read())
+    assert [e["why"] for e in data2["violations"]] == [
+        "intentional hits-gate sync"]
+
+
+def test_cli_explain_known_and_unknown_rule(capsys):
+    from dwpa_tpu.analysis.__main__ import main as cli_main
+
+    assert cli_main(["--explain", "DW301"]) == 0
+    out = capsys.readouterr().out
+    assert "DW301" in out and "Example" in out
+    assert cli_main(["--explain", "DW999"]) == 2
+
+
+def test_summary_carries_per_rule_timings(tmp_path, capsys):
+    from dwpa_tpu.analysis.__main__ import main as cli_main
+
+    root = _conc_tree(tmp_path, "x = 1\n")
+    empty = tmp_path / "b.json"
+    empty.write_text('{"version": 1, "violations": []}')
+    assert cli_main([root, "--baseline", str(empty)]) == 0
+    out = capsys.readouterr().out
+    for key in ("lint=", "DW301=", "DW302=", "DW303=", "DW304="):
+        assert key in out

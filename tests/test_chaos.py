@@ -373,7 +373,7 @@ def test_degraded_mode_cracks_buffered_units(tmp_path):
 
 
 @pytest.mark.slow
-def test_chaos_soak_full_unit_parity(tmp_path):
+def test_chaos_soak_full_unit_parity(tmp_path, lock_witness):
     SEED = 20260805
     lines = [tfx.make_pmkid_line(PSK, ESSID, seed="cs1"),
              tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="cs2")]
@@ -410,68 +410,72 @@ def test_chaos_soak_full_unit_parity(tmp_path):
         plan.force("put_work", "reject")
         return plan
 
-    # Leg 2: same servers-side state, seeded chaos schedule.
-    core1 = build_server("s1")
-    plan = make_plan()
-    clock = VirtualClock()
-    threads_before = set(threading.enumerate())
-    client1, wsgi1 = _client(core1, tmp_path / "w1", plan, clock)
-    work1 = client1.api.get_work(1)  # survives timeout, 5xx, torn body
+    # The witness watches every lock leg 2 creates (client, feed,
+    # outbox, server core): a cycle in the witnessed acquisition
+    # order fails the soak even when the interleaving got lucky.
+    with lock_witness(label="chaos soak leg 2"):
+        # Leg 2: same servers-side state, seeded chaos schedule.
+        core1 = build_server("s1")
+        plan = make_plan()
+        clock = VirtualClock()
+        threads_before = set(threading.enumerate())
+        client1, wsgi1 = _client(core1, tmp_path / "w1", plan, clock)
+        work1 = client1.api.get_work(1)  # survives timeout, 5xx, torn body
 
-    # Mid-unit client restart: checkpoint, then a fresh process over the
-    # same workdir replays the unit instead of fetching new work.
-    client1._write_resume(work1)
-    client2, _ = _client(core1, tmp_path / "w1", plan, clock)
-    replayed = client2._read_resume()
-    assert replayed == work1
+        # Mid-unit client restart: checkpoint, then a fresh process over the
+        # same workdir replays the unit instead of fetching new work.
+        client1._write_resume(work1)
+        client2, _ = _client(core1, tmp_path / "w1", plan, clock)
+        replayed = client2._read_resume()
+        assert replayed == work1
 
-    res1 = client2.process_work(replayed)
-    founds1 = sorted(f.psk for f in res1.founds)
-    assert founds1 == founds0  # no founds lost under faults
+        res1 = client2.process_work(replayed)
+        founds1 = sorted(f.psk for f in res1.founds)
+        assert founds1 == founds0  # no founds lost under faults
 
-    # First put_work reply was torn, the drain's hit the forced reject:
-    # the founds sit durably in the outbox until a clean exchange lands.
-    for _ in range(10):
-        if not client2.outbox.pending_count():
-            break
-        clock.sleep(client2.api.breaker.cooldown)
-        try:
-            client2._drain_outbox()
-        except ConnectionError:
-            continue
-    assert client2.outbox.pending_count() == 0
+        # First put_work reply was torn, the drain's hit the forced reject:
+        # the founds sit durably in the outbox until a clean exchange lands.
+        for _ in range(10):
+            if not client2.outbox.pending_count():
+                break
+            clock.sleep(client2.api.breaker.cooldown)
+            try:
+                client2._drain_outbox()
+            except ConnectionError:
+                continue
+        assert client2.outbox.pending_count() == 0
 
-    # Server-side parity with the fault-free leg: same nets cracked to
-    # the same PSK, no extra rows — repeated put_work exchanges (torn
-    # reply + redrives) never produced a duplicate accepted submission.
-    state1 = sorted((r["n_state"], r["pass"])
-                    for r in core1.db.q("SELECT n_state, pass FROM nets"))
-    assert state1 == state0
-    assert core1.db.q1("SELECT COUNT(*) c FROM nets")["c"] == len(lines)
-    # The processed unit's lease is consumed exactly like the clean leg.
-    assert core1.db.q1("SELECT COUNT(*) c FROM n2d WHERE hkey = ?",
-                       (replayed["hkey"],))["c"] == 0
-    # Resume cleared on both legs.
-    assert not os.path.exists(client0.resume_path)
-    assert not os.path.exists(client2.resume_path)
+        # Server-side parity with the fault-free leg: same nets cracked to
+        # the same PSK, no extra rows — repeated put_work exchanges (torn
+        # reply + redrives) never produced a duplicate accepted submission.
+        state1 = sorted((r["n_state"], r["pass"])
+                        for r in core1.db.q("SELECT n_state, pass FROM nets"))
+        assert state1 == state0
+        assert core1.db.q1("SELECT COUNT(*) c FROM nets")["c"] == len(lines)
+        # The processed unit's lease is consumed exactly like the clean leg.
+        assert core1.db.q1("SELECT COUNT(*) c FROM n2d WHERE hkey = ?",
+                           (replayed["hkey"],))["c"] == 0
+        # Resume cleared on both legs.
+        assert not os.path.exists(client0.resume_path)
+        assert not os.path.exists(client2.resume_path)
 
-    # Every required fault kind actually fired.
-    assert {"timeout", "http_5xx", "truncate",
-            "reject"} <= plan.kinds_injected()
+        # Every required fault kind actually fired.
+        assert {"timeout", "http_5xx", "truncate",
+                "reject"} <= plan.kinds_injected()
 
-    # Same seed -> bit-identical fault schedule over the same calls.
-    replay = make_plan()
-    for _, endpoint, _ in plan.schedule():
-        replay.next_fault(endpoint)
-    assert replay.schedule() == plan.schedule()
+        # Same seed -> bit-identical fault schedule over the same calls.
+        replay = make_plan()
+        for _, endpoint, _ in plan.schedule():
+            replay.next_fault(endpoint)
+        assert replay.schedule() == plan.schedule()
 
-    # Clean teardown: nothing the run spawned is still alive.
-    deadline = time.time() + 10.0
-    while time.time() < deadline:
-        spawned = [t for t in set(threading.enumerate()) - threads_before
-                   if t.is_alive()]
-        if not spawned:
-            break
-        for t in spawned:
-            t.join(timeout=0.5)
-    assert not spawned, f"threads leaked: {spawned}"
+        # Clean teardown: nothing the run spawned is still alive.
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            spawned = [t for t in set(threading.enumerate()) - threads_before
+                       if t.is_alive()]
+            if not spawned:
+                break
+            for t in spawned:
+                t.join(timeout=0.5)
+        assert not spawned, f"threads leaked: {spawned}"

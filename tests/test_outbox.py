@@ -189,3 +189,51 @@ def test_frame_walker_rejects_bad_magic(tmp_path):
         {"op": "found", "hkey": "h", "k": "b", "v": "02"})
     recs = [r for r, _ in _walk_frames(blob)]
     assert [r["k"] for r in recs] == ["a"]  # stops at the bad magic
+
+
+def test_concurrent_record_and_ack_keep_journal_intact(tmp_path):
+    """Regression (concurrency rule DW302): record/ack hammered from
+    threads must never tear a journal frame, double-create the file, or
+    drop state — the mutators serialize on the outbox mutex.  Replay
+    from a fresh handle is the ground truth."""
+    import threading
+
+    box = FoundOutbox(str(tmp_path))
+    N = 60
+    errs = []
+
+    def recorder(tid):
+        try:
+            for i in range(N):
+                box.record(f"hk{tid}", [_cand("%02x" % i, "%04x" % (tid + i))])
+        except Exception as e:  # pragma: no cover - must not happen
+            errs.append(e)
+
+    def acker():
+        try:
+            for i in range(0, N, 2):
+                box.ack("hk0", [_cand("%02x" % i, "ignored")])
+        except Exception as e:  # pragma: no cover - must not happen
+            errs.append(e)
+
+    threads = [threading.Thread(target=recorder, args=(t,))
+               for t in range(3)] + [threading.Thread(target=acker)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert errs == []
+    box.close()
+
+    # Every frame intact (no torn/interleaved writes), and replay agrees
+    # with the in-memory verdict: acked keys gone, the rest pending.
+    blob = open(box.path, "rb").read()
+    frames = list(_walk_frames(blob))
+    assert frames and frames[-1][1] == len(blob)  # walker consumed it all
+    box2 = FoundOutbox(str(tmp_path))
+    pend = box2.pending()
+    assert len(pend.get("hk0", [])) == N - len(range(0, N, 2))
+    assert len(pend["hk1"]) == N and len(pend["hk2"]) == N
+    for i in range(0, N, 2):
+        assert all(c["k"] != "%02x" % i for c in pend.get("hk0", []))
+    box2.close()

@@ -360,3 +360,29 @@ def test_executor_recovers_on_transient_error():
     done = ex.run()
     assert len(done) == 2 and all(len(u.founds) == 1 for u in done)
     assert ex.failed == []
+
+
+def test_executor_leaves_no_orphan_threads():
+    """Thread-lifecycle audit: run() joins its unit producer (and the
+    per-device stream drainers join inside the wave), so no ``sched-*``
+    thread survives a completed run — the feed-soak no-orphan idiom
+    extended to the executor."""
+    import threading
+
+    def _sched_threads():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("sched-") and t.is_alive()]
+
+    psk = b"orphan-check-1"
+    line = synth.make_pmkid_line(psk, b"OrphanNet", seed="oc")
+    units = [WorkUnit(uid=i, lines=[line],
+                      words=[b"w%04d" % i, psk])
+             for i in range(3)]
+    ex = MultiUnitExecutor(units, batch_size=BATCH, unit_queue=2)
+    done = ex.run()
+    assert len(done) == 3
+    deadline = __import__("time").time() + 10.0
+    while _sched_threads() and __import__("time").time() < deadline:
+        for t in _sched_threads():
+            t.join(timeout=0.2)
+    assert _sched_threads() == []

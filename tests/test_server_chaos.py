@@ -276,7 +276,7 @@ def _accepted_claims(core) -> float:
 
 
 @pytest.mark.slow
-def test_server_chaos_soak_storm(tmp_path):
+def test_server_chaos_soak_storm(tmp_path, lock_witness):
     """Threaded client storm against a file-backed core with seeded db
     faults and two mid-storm core restarts.  Afterwards the reopened
     ledger passes the invariant sweep, every cracked net was accepted
@@ -317,107 +317,111 @@ def test_server_chaos_soak_storm(tmp_path):
     assert sched_a == sched_b
     assert ops_a == ops_b
 
-    # -- the storm: threads x ops through the real WSGI app + retry stack
-    dbpath = str(tmp_path / "storm.sqlite")
-    seed_core = _core(Database(dbpath), nets=8, dicts=3)
-    psk_by_essid = {("StormNet%d" % i).encode(): PSKS[i % len(PSKS)]
-                    for i in range(8)}
-    seed_core.db.conn.close()
+    # Every lock the storm creates (cores across restarts, retry
+    # stacks, queues) reports to the witness: an acquisition-order
+    # cycle fails the soak regardless of interleaving luck.
+    with lock_witness(label="server chaos storm"):
+        # -- the storm: threads x ops through the real WSGI app + retry stack
+        dbpath = str(tmp_path / "storm.sqlite")
+        seed_core = _core(Database(dbpath), nets=8, dicts=3)
+        psk_by_essid = {("StormNet%d" % i).encode(): PSKS[i % len(PSKS)]
+                        for i in range(8)}
+        seed_core.db.conn.close()
 
-    state = {"gen": 0}
-    accepted_total = [0.0]
-    holder = {}
-    swap_lock = threading.Lock()
+        state = {"gen": 0}
+        accepted_total = [0.0]
+        holder = {}
+        swap_lock = threading.Lock()
 
-    def open_core():
-        from dwpa_tpu.obs import MetricsRegistry
+        def open_core():
+            from dwpa_tpu.obs import MetricsRegistry
 
-        # fresh registry per generation: banking the accept counter at
-        # each restart must not re-count the shared process-wide one
-        core = ServerCore(Database(dbpath), max_inflight=64,
-                          registry=MetricsRegistry())
-        holder["core"] = core
-        holder["app"] = make_wsgi_app(core)
-        return core
+            # fresh registry per generation: banking the accept counter at
+            # each restart must not re-count the shared process-wide one
+            core = ServerCore(Database(dbpath), max_inflight=64,
+                              registry=MetricsRegistry())
+            holder["core"] = core
+            holder["app"] = make_wsgi_app(core)
+            return core
 
-    open_core()
+        open_core()
 
-    def restart():
-        """Mid-storm core 'kill': bank the old core's accept counter,
-        drop its connection without any graceful shutdown, reopen."""
-        with swap_lock:
-            old = holder["core"]
-            accepted_total[0] += _accepted_claims(old)
-            state["gen"] += 1
-            try:
-                old.db.conn.close()
-            except sqlite3.Error:
-                pass
-            open_core()
-
-    def app_proxy(environ, start_response):
-        with swap_lock:
-            app = holder["app"]
-        return app(environ, start_response)
-
-    errs = []
-    stop = threading.Event()
-
-    def client_thread(idx):
-        from dwpa_tpu.models import hashline as hl
-
-        rng = random.Random(SEED + idx)
-        api, clock = _api(app_proxy, max_tries=4, backoff=0.01,
-                          rng=random.Random(SEED + idx))
-        try:
-            for _ in range(30):
-                if stop.is_set():
-                    return
+        def restart():
+            """Mid-storm core 'kill': bank the old core's accept counter,
+            drop its connection without any graceful shutdown, reopen."""
+            with swap_lock:
+                old = holder["core"]
+                accepted_total[0] += _accepted_claims(old)
+                state["gen"] += 1
                 try:
-                    w = api.get_work(1)
-                except ConnectionError:
-                    continue
-                except RuntimeError:
-                    continue  # "No nets"/version sentinels
-                cand = []
-                if rng.random() < 0.5:  # half the units get cracked
-                    for line in w["hashes"]:
-                        h = hl.parse(line)
-                        psk = psk_by_essid.get(h.essid)
-                        if psk:
-                            cand.append({"k": h.mac_ap.hex(),
-                                         "v": psk.hex()})
-                try:
-                    api.put_work(w["hkey"], cand, epoch=w.get("epoch"))
-                except ConnectionError:
+                    old.db.conn.close()
+                except sqlite3.Error:
                     pass
-        except Exception as e:  # pragma: no cover - storm must not leak
-            errs.append(e)
+                open_core()
 
-    threads = [threading.Thread(target=client_thread, args=(i,))
-               for i in range(8)]
-    for t in threads:
-        t.start()
-    # two mid-storm restarts while clients are live
-    import time as _time
-    _time.sleep(0.3)
-    restart()
-    _time.sleep(0.3)
-    restart()
-    for t in threads:
-        t.join(60)
-    stop.set()
-    assert not errs
+        def app_proxy(environ, start_response):
+            with swap_lock:
+                app = holder["app"]
+            return app(environ, start_response)
 
-    # bank the final generation and judge the ledger from a fresh handle
-    accepted_total[0] += _accepted_claims(holder["core"])
-    holder["core"].db.conn.close()
-    final = Database(dbpath)
-    assert sweep_invariants(final) == []
-    cracked = final.q1(
-        "SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"]
-    # zero duplicate accepted founds: every accept event corresponds to
-    # exactly one net crossing into n_state=1 (acceptance is idempotent
-    # across duplicate submits and restarts)
-    assert accepted_total[0] == cracked
-    assert state["gen"] == 2
+        errs = []
+        stop = threading.Event()
+
+        def client_thread(idx):
+            from dwpa_tpu.models import hashline as hl
+
+            rng = random.Random(SEED + idx)
+            api, clock = _api(app_proxy, max_tries=4, backoff=0.01,
+                              rng=random.Random(SEED + idx))
+            try:
+                for _ in range(30):
+                    if stop.is_set():
+                        return
+                    try:
+                        w = api.get_work(1)
+                    except ConnectionError:
+                        continue
+                    except RuntimeError:
+                        continue  # "No nets"/version sentinels
+                    cand = []
+                    if rng.random() < 0.5:  # half the units get cracked
+                        for line in w["hashes"]:
+                            h = hl.parse(line)
+                            psk = psk_by_essid.get(h.essid)
+                            if psk:
+                                cand.append({"k": h.mac_ap.hex(),
+                                             "v": psk.hex()})
+                    try:
+                        api.put_work(w["hkey"], cand, epoch=w.get("epoch"))
+                    except ConnectionError:
+                        pass
+            except Exception as e:  # pragma: no cover - storm must not leak
+                errs.append(e)
+
+        threads = [threading.Thread(target=client_thread, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        # two mid-storm restarts while clients are live
+        import time as _time
+        _time.sleep(0.3)
+        restart()
+        _time.sleep(0.3)
+        restart()
+        for t in threads:
+            t.join(60)
+        stop.set()
+        assert not errs
+
+        # bank the final generation and judge the ledger from a fresh handle
+        accepted_total[0] += _accepted_claims(holder["core"])
+        holder["core"].db.conn.close()
+        final = Database(dbpath)
+        assert sweep_invariants(final) == []
+        cracked = final.q1(
+            "SELECT COUNT(*) c FROM nets WHERE n_state = 1")["c"]
+        # zero duplicate accepted founds: every accept event corresponds to
+        # exactly one net crossing into n_state=1 (acceptance is idempotent
+        # across duplicate submits and restarts)
+        assert accepted_total[0] == cracked
+        assert state["gen"] == 2

@@ -427,3 +427,27 @@ def test_cli_pack_client_reads_conf(tmp_path, capsys):
 def test_serve_with_jobs_rejects_memory_db():
     with pytest.raises(SystemExit, match="file-backed"):
         cli_main(["serve", "--db", ":memory:", "--with-jobs"])
+
+
+def test_materializer_thread_stops_and_joins(core):
+    """Thread-lifecycle audit: the serve-mode queue materializer must be
+    stoppable (stop event honored within one tick) and joinable — no
+    orphan ``dwpa-queue-materializer`` thread after shutdown."""
+    import threading
+
+    from dwpa_tpu.server.__main__ import _start_materializer
+
+    before = set(threading.enumerate())
+    started = _start_materializer(core, interval=0.05)
+    assert started is not None
+    thread, stop = started
+    assert thread.name == "dwpa-queue-materializer"
+    assert thread.is_alive()
+    stop.set()
+    thread.join(5.0)
+    assert not thread.is_alive()
+    assert set(threading.enumerate()) == before
+
+    # Queue disabled (--no-work-queue): no thread to manage at all.
+    core.queue = None
+    assert _start_materializer(core) is None
