@@ -1001,6 +1001,137 @@ def bench_server_load(sessions: int = 2000, threads: int = 16,
                               / max(scan["issues_per_s"], 1e-9))}
 
 
+def bench_server_precrack(nets: int = 48, group: int = 16,
+                          vendor_words: int = 256, imei_words: int = 32,
+                          batch: int = 2048) -> dict:
+    """Batched server-side pre-crack vs the scalar per-candidate sweep
+    (PR: batched pre-crack).
+
+    ``nets`` synthetic PMKID nets in ``nets // group`` sibling groups
+    share an ESSID, mirroring the war-driving capture shape the fused
+    sweep exists for: the scalar loop pays one PBKDF2 per (net,
+    candidate) while the fused wave dedups every shared (essid, word)
+    pair to a single derivation.  Candidate mix per net: vendor pack +
+    IMEI sweep + Single/Pattern mutations, plus replay/dict rows fed by
+    one pre-cracked seed per group.  One net per group carries a
+    last-vendor-word PSK so each leg must scan the full pack before its
+    hit; the rest are misses (full sweep).  Reports candidates/s for
+    both legs, whether they cracked the exact same free-found set, and
+    the warm-path recompile count (must be 0).
+    """
+    from dwpa_tpu.models import hashline as hl
+    from dwpa_tpu.obs import MetricsRegistry
+    from dwpa_tpu.oracle import m22000 as oracle
+    from dwpa_tpu.server import Database, ServerCore
+    from dwpa_tpu.server.core import SERVER_NC
+    from dwpa_tpu.server.db import long2mac
+    from dwpa_tpu.server.precrack import PrecrackEngine
+
+    groups = nets // group
+
+    def essid_of(i):
+        return b"PrecrackBench%02d" % (i % groups)
+
+    def psk_of(i):
+        if i % group == 0:  # group seed: cracked before either sweep
+            return b"benchsecret-%02d!" % (i % groups)
+        if i % group == 1:  # hit on the LAST vendor word: full pack scan
+            return essid_of(i).lower() + b"-key-%03d" % (vendor_words - 1)
+        return b"bench-miss-%04d" % i  # unmatchable: full sweep
+
+    gens = [
+        lambda bssid, ssid: [("BenchVendor",
+                              ssid.lower() + b"-key-%03d" % k)
+                             for k in range(vendor_words)],
+        lambda bssid, ssid: [("IMEI", b"3526%011d" % k)
+                             for k in range(imei_words)],
+    ]
+
+    def build_server():
+        core = ServerCore(Database(":memory:"), registry=MetricsRegistry())
+        core.add_hashlines([T.make_pmkid_line(psk_of(i), essid_of(i),
+                                              seed=f"pcb{i}")
+                            for i in range(nets)])
+        rows = core.db.q("SELECT * FROM nets ORDER BY net_id")
+        for i in range(0, nets, group):  # crack the group seeds
+            core._try_accept(rows[i], psk_of(i))
+        core.db.x("UPDATE nets SET algo = 'Manual' "
+                  "WHERE n_state = 1 AND algo IS NULL")
+        return core
+
+    def scalar_sweep(core):
+        # the per-candidate loop the engine supersedes (keygen_precompute
+        # shape): same candidate stream, same per-net tx, but one full
+        # PBKDF2 per check_key_m22000 call
+        eng = PrecrackEngine(core, device="off", batch=batch,
+                             generators=gens)
+        db = core.db
+        corpus = eng._dict_corpus()
+        plan = []
+        for net in db.q("SELECT * FROM nets WHERE algo IS NULL "
+                        "AND n_state = 0 ORDER BY net_id"):
+            h = hl.parse(net["struct"])
+            plan.append((net, h, eng._collect(net, h,
+                                              long2mac(net["bssid"]),
+                                              corpus)))
+        found = total = 0
+        for net, h, cands in plan:
+            total += len(cands)
+            tried, hit = [], None
+            for _, algo, cand in cands:
+                tried.append((algo, cand))
+                r = oracle.check_key_m22000(h, [cand], nc=SERVER_NC)
+                if r:
+                    hit = (algo, cand, r)
+                    break
+            with core._getwork_lock:
+                with db.tx():
+                    for algo, cand in tried:
+                        db.x("INSERT INTO rkg(net_id, algo, pass) "
+                             "VALUES (?, ?, ?)",
+                             (net["net_id"], algo, cand))
+                    if hit:
+                        _, cand, r = hit
+                        core._mark_cracked(net["net_id"], r[0], r[3],
+                                           r[1] or 0, r[2] or "")
+                        db.x("UPDATE rkg SET n_state = 1 "
+                             "WHERE net_id = ? AND pass = ?",
+                             (net["net_id"], cand))
+                        found += 1
+                    db.x("UPDATE nets SET algo = ? WHERE net_id = ?",
+                         (hit[0] if hit else "", net["net_id"]))
+        return {"cracked": found, "candidates": total}
+
+    def founds(core):
+        return {(r["ssid"], r["pass"]) for r in core.db.q(
+            "SELECT ssid, pass FROM nets WHERE n_state = 1")}
+
+    if ON_TPU:  # compile the fused widths off the clock
+        PrecrackEngine(build_server(), device="auto", batch=batch,
+                       generators=gens).run(limit=nets)
+
+    sc, fc = build_server(), build_server()
+    box = {}
+    s_scalar = _timed(lambda: box.update(scalar=scalar_sweep(sc)),
+                      "bench:server_precrack_scalar")
+    feng = PrecrackEngine(fc, device="auto", batch=batch, generators=gens)
+    with watch_compiles() as comp:
+        s_fused = _timed(lambda: box.update(fused=feng.run(limit=nets)),
+                         "bench:server_precrack_fused")
+    cands = box["scalar"]["candidates"]
+    return {"label": "server_precrack", "nets": nets, "groups": groups,
+            "candidates": cands,
+            "scalar_seconds": s_scalar, "fused_seconds": s_fused,
+            "scalar_cands_per_s": cands / max(s_scalar, 1e-9),
+            "fused_cands_per_s": cands / max(s_fused, 1e-9),
+            "speedup": s_scalar / max(s_fused, 1e-9),
+            "free_founds": box["fused"]["cracked"],
+            "found_parity": (founds(sc) == founds(fc)
+                             and box["scalar"]["cracked"]
+                             == box["fused"]["cracked"] == groups),
+            "recompiles_warm": comp.count}
+
+
 def _timed(fn, name: str = "bench:timed") -> float:
     """One rep as a span: the body must sync its own device work (every
     caller passes an engine crack* call, which does)."""
@@ -1127,6 +1258,7 @@ def main():
     overhead = bench_unit_overhead(pmkid)
     resilience = bench_resilience(batch)
     server_load = bench_server_load()
+    server_precrack = bench_server_precrack(batch=batch)
 
     value = mask["pmk_per_s"]
     print(
@@ -1156,6 +1288,7 @@ def main():
                     "unit_overhead": _round(overhead),
                     "resilience": _round(resilience),
                     "server_load": _round(server_load),
+                    "server_precrack": _round(server_precrack),
                 },
             }
         )

@@ -131,6 +131,16 @@ Rule codes (stable — referenced by baseline.json and the docs):
   inside ``Database.tx()``; a SINGLE lexical write site is fine even
   in a loop (per-row autocommit around network calls, e.g. geolocate,
   is a deliberate pattern, not a tear).
+- **DW115 precrack-scalar-verify** — a per-candidate
+  ``check_key_m22000(h, [single_key], ...)`` call inside a ``for``/
+  ``while`` loop in server code (``dwpa_tpu/server/``, excluding the
+  sanctioned host-oracle fallback seam, ``server/precrack.py``).  Each
+  such call pays a full PBKDF2-HMAC-SHA1 (4096 iterations, ~99% of an
+  m22000 verdict) on the request/cron thread, once per candidate.
+  Candidate sweeps belong behind ``server.precrack`` (``verify_batch``
+  / ``PmkBatcher.prewarm``): PMKs derive once per fused mixed-ESSID
+  batch, verdicts still finish through the same oracle call — bit-
+  identical results, batch-width fewer PBKDF2 runs per sweep.
 
 The linter is repo-native, not general-purpose: rules are scoped to the
 paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
@@ -160,6 +170,10 @@ CLIENT_TRANSPORT_FILE = "dwpa_tpu/client/protocol.py"
 
 #: the package whose multi-statement write atomicity DW114 polices
 SERVER_DIR = "dwpa_tpu/server/"
+#: the one server file allowed to run per-candidate oracle calls in a
+#: loop (DW115): the pre-crack module's own host fallback — the seam
+#: every other server-side candidate sweep is routed through
+PRECRACK_FALLBACK_FILES = ("dwpa_tpu/server/precrack.py",)
 
 #: metric-emission methods DW106 bans inside traced functions
 OBS_EMIT_METHODS = {"inc", "dec", "observe", "set"}
@@ -172,7 +186,8 @@ _PMKSTORE_RECV = re.compile(r"(?i)(pmk_?store$|^store$|^_store$)")
 #: the consumer-thread write-back set: the only files allowed to call a
 #: store's ``.put`` (DW108(b)) — the store itself and the engine's
 #: post-device-fetch write-back seam
-PMKSTORE_WRITEBACK_FILES = ("dwpa_tpu/pmkstore/", "dwpa_tpu/models/m22000.py")
+PMKSTORE_WRITEBACK_FILES = ("dwpa_tpu/pmkstore/", "dwpa_tpu/models/m22000.py",
+                            "dwpa_tpu/server/precrack.py")
 
 #: directories whose producer-thread discipline DW107(b) polices
 FEED_DIRS = ("dwpa_tpu/feed",)
@@ -1182,6 +1197,44 @@ def _check_server_db_atomicity(tree, path, src_lines, out):
 
 
 # ---------------------------------------------------------------------------
+# DW115: server-side scalar candidate verification
+# ---------------------------------------------------------------------------
+
+
+def _check_precrack_scalar_verify(tree, path, src_lines, out):
+    """DW115: ``check_key_m22000(h, [one_key], ...)`` — second argument
+    a single-element list literal — lexically inside a ``for``/``while``
+    loop, in server code outside the pre-crack fallback seam.
+
+    The single-element-list shape is the scalar tell: a batched call
+    passes the whole candidate list (a name or comprehension) and lets
+    the oracle scan it, while ``[k]`` in a loop means one full PBKDF2
+    derivation per iteration on the request/cron thread.  Matching
+    call nodes are deduplicated so nested loops flag each site once."""
+    flagged = set()
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if (isinstance(node, ast.Call)
+                    and _call_name(node) == "check_key_m22000"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.List)
+                    and len(node.args[1].elts) == 1
+                    and id(node) not in flagged):
+                flagged.add(id(node))
+                out.append(Violation(
+                    "DW115", path, node.lineno,
+                    "per-candidate check_key_m22000(h, [key]) inside a "
+                    "loop — one full PBKDF2 per iteration on the server "
+                    "thread; route the sweep through server.precrack "
+                    "(verify_batch / PmkBatcher.prewarm), which derives "
+                    "PMKs once per fused mixed-ESSID batch and finishes "
+                    "verdicts through the same oracle",
+                    _line(src_lines, node)))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1224,6 +1277,8 @@ def lint_source(src: str, path: str) -> list:
         _check_client_transport(tree, path, src_lines, out)
     if path.startswith(SERVER_DIR):
         _check_server_db_atomicity(tree, path, src_lines, out)
+        if path not in PRECRACK_FALLBACK_FILES:
+            _check_precrack_scalar_verify(tree, path, src_lines, out)
     return out
 
 

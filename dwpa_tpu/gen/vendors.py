@@ -461,12 +461,15 @@ def imei_hotspot_keys(limit_per_tac: int = 64):
 # Dispatch
 
 def vendor_candidates(bssid: bytes, ssid: bytes, thomson_kw=None,
-                      alice_configs=None):
+                      alice_configs=None, imei_limit: int = None):
     """The default ``extra_generators`` plug-in for keygen precompute.
 
     Yields ``(algo, candidate)`` pairs for every vendor family whose
     SSID/BSSID fingerprint matches (routerkeygen-cli dispatch equivalent,
-    web/rkg.php:109).
+    web/rkg.php:109).  ``imei_limit`` widens (or narrows) the per-TAC
+    IMEI serial slice — the batched server pre-crack path absorbs a much
+    deeper sweep than the per-candidate host loop the default budget was
+    sized for.
     """
     m = THOMSON_SSID_RE.match(ssid)
     if m:
@@ -498,7 +501,8 @@ def vendor_candidates(bssid: bytes, ssid: bytes, thomson_kw=None,
         for key in wps_pin_keys(bssid):
             yield ("WPSPin", key)
     if HOTSPOT_SSID_RE.match(ssid):
-        for key in imei_hotspot_keys():
+        for key in (imei_hotspot_keys() if imei_limit is None
+                    else imei_hotspot_keys(limit_per_tac=imei_limit)):
             yield ("IMEI", key)
     if ZYXEL_SSID_RE.match(ssid):
         for key in zyxel_keys(bssid):

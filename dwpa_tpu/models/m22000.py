@@ -75,6 +75,26 @@ def essid_salt_blocks(essid: bytes):
     return out[0], out[1]
 
 
+def essid_salt_lanes(essids):
+    """Stacked per-lane salt tables for a mixed-ESSID batch.
+
+    Row ``b`` of each returned uint32[B, 16] array is
+    ``essid_salt_blocks(essids[b])`` — the rank-2 salt mode of
+    ``pmk_kernel`` (one lane, one ESSID).  Repeated ESSIDs share one
+    derivation, so a sibling-heavy server pre-crack wave pays the salt
+    padding once per distinct network name.
+    """
+    cache = {}
+    lanes1, lanes2 = [], []
+    for essid in essids:
+        pair = cache.get(essid)
+        if pair is None:
+            pair = cache[essid] = essid_salt_blocks(essid)
+        lanes1.append(pair[0])
+        lanes2.append(pair[1])
+    return np.stack(lanes1), np.stack(lanes2)
+
+
 def _hmac_msg_blocks(data: bytes, little_endian: bool = False) -> np.ndarray:
     """Pad an HMAC inner message (keyed by one 64-byte block) -> [nb, 16]."""
     return np.asarray(

@@ -291,6 +291,14 @@ class PMKStore:
             self._evict()
             self._m_bytes.set(self._total_bytes())
 
+    def put_many(self, items):
+        """Append write-back for a mixed-ESSID wave: ``items`` iterates
+        ``(essid, words, pmks)`` triples (one ``put`` each).  The server
+        pre-crack sweep derives many ESSIDs in one fused batch and lands
+        them here grouped, so every group pays one frame append."""
+        for essid, words, pmks in items:
+            self.put(essid, words, pmks)
+
     @staticmethod
     def _pmk_bytes(pmks, n: int) -> list:
         if isinstance(pmks, (list, tuple)):

@@ -32,7 +32,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..models.m22000 import MAX_PSK_LEN, MIN_PSK_LEN, essid_salt_blocks
+from ..models.m22000 import (MAX_PSK_LEN, MIN_PSK_LEN, essid_salt_blocks,
+                             essid_salt_lanes)
 from ..oracle import m22000 as oracle
 from ..pmkstore.store import word_digest
 from ..utils import bytesops as bo
@@ -60,6 +61,38 @@ def fused_width(batch: int, n: int, total: int) -> int:
         if total <= w:
             return w
     return batch
+
+
+def pack_salted_lanes(pairs, batch_size: int, n: int):
+    """Derive-only mixed-ESSID packing (the server pre-crack path).
+
+    ``pairs``: list of ``(essid, word)`` with words already decoded and
+    length-valid (8..63 bytes); at most ``batch_size`` of them.  Returns
+    ``(rows uint32[W, 16], salt1 uint32[W, 16], salt2 uint32[W, 16],
+    nvalid)`` padded to the static fused width, ready for the per-lane
+    rank-2 salt mode of ``pmk_kernel``.  Unlike ``fuse_units`` there is
+    no unit table and no store split — the caller demuxes lanes itself —
+    so the same ESSID may occupy many lanes.  Dead padding lanes repeat
+    lane 0 (word and salt), never introducing a new salt row.
+    """
+    if not pairs:
+        raise ValueError("pack_salted_lanes needs at least one lane")
+    if len(pairs) > batch_size:
+        raise ValueError(
+            f"{len(pairs)} lanes overflow fused batch {batch_size}")
+    W = fused_width(batch_size, n, len(pairs))
+    rows = np.zeros((W, 16), np.uint32)
+    salt1 = np.zeros((W, 16), np.uint32)
+    salt2 = np.zeros((W, 16), np.uint32)
+    rows[:len(pairs)] = bo.pack_passwords_be(
+        [w for _, w in pairs]).astype(np.uint32)
+    salt1[:len(pairs)], salt2[:len(pairs)] = essid_salt_lanes(
+        [e for e, _ in pairs])
+    if len(pairs) < W:
+        rows[len(pairs):] = rows[0]
+        salt1[len(pairs):] = salt1[0]
+        salt2[len(pairs):] = salt2[0]
+    return rows, salt1, salt2, len(pairs)
 
 
 @dataclass

@@ -120,6 +120,17 @@ def cmd_serve(args):
 
     setup_logging()
     serve_core = _core(args)
+    if not getattr(args, "no_precrack_ingest", False):
+        # Ingestion-time pre-crack: add_hashlines hands freshly inserted
+        # net ids to this engine AFTER the ingest tx commits, so every
+        # new net gets its vendor/IMEI/replay candidate sweep before any
+        # client ever leases it.
+        from .precrack import PrecrackEngine
+
+        serve_core.precrack = PrecrackEngine(
+            serve_core, batch=args.precrack_batch,
+            device=args.precrack_device,
+            dict_limit=args.precrack_dict_limit)
     app = make_wsgi_app(serve_core)
     if getattr(args, "with_jobs", False):
         # The cron layer in-process: its own ServerCore (sqlite handles
@@ -246,7 +257,8 @@ def cmd_jobs(args):
     with --loop (maintenance hourly, keygen every 5 min, enrichment every
     10 min — the INSTALL.md:47-52 cadence)."""
     from ..obs import setup_logging
-    from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
+    from .jobs import (geolocate, keygen_precompute, maintenance, precrack,
+                       psk_lookup)
 
     setup_logging()
     core = _core(args)
@@ -254,7 +266,12 @@ def cmd_jobs(args):
     if not args.loop:
         out = {"maintenance": maintenance(core),
                "keygen": keygen_precompute(
-                   core, extra_generators=_keygen_gens(args))}
+                   core, extra_generators=_keygen_gens(args)),
+               "precrack": precrack(
+                   core, limit=args.precrack_limit,
+                   batch=args.precrack_batch,
+                   device=args.precrack_device,
+                   dict_limit=args.precrack_dict_limit)}
         if geo:
             out["geolocate"] = geolocate(core, geo)
         if psk:
@@ -270,11 +287,12 @@ def _jobs_loop(core, args, geo, psk):
     (sqlite lock contention, I/O hiccups) are logged and retried next
     tick — one bad pass must not end the cron layer for good."""
     from ..obs import get_logger
-    from .jobs import geolocate, keygen_precompute, maintenance, psk_lookup
+    from .jobs import (geolocate, keygen_precompute, maintenance, precrack,
+                       psk_lookup)
 
     log = get_logger("server.jobs")
     gens = _keygen_gens(args)
-    last_maint = last_enrich = 0.0
+    last_maint = last_enrich = last_precrack = 0.0
     while True:
         now = time.time()
         try:
@@ -287,6 +305,12 @@ def _jobs_loop(core, args, geo, psk):
                 if psk:
                     psk_lookup(core, psk)
                 last_enrich = now
+            if now - last_precrack >= args.precrack_interval:
+                precrack(core, limit=args.precrack_limit,
+                         batch=args.precrack_batch,
+                         device=args.precrack_device,
+                         dict_limit=args.precrack_dict_limit)
+                last_precrack = now
             keygen_precompute(core, extra_generators=gens)
         except Exception:
             log.exception("jobs tick failed (will retry)")
@@ -406,6 +430,27 @@ def main(argv=None):
                         help="JSON vendor keygen pack (gen/vendor_data.py "
                              "format): adds data-driven routerkeygen "
                              "families to keygen precompute")
+        sp.add_argument("--precrack-interval", type=float, default=300,
+                        help="server-side pre-crack sweep cadence in "
+                             "seconds (fused mixed-ESSID PMK derivation "
+                             "over every unprocessed net's candidates)")
+        sp.add_argument("--precrack-batch", type=int, default=2048,
+                        help="fused PMK derivation width per pre-crack "
+                             "wave (sched/fuse.py static widths)")
+        sp.add_argument("--precrack-device", choices=("auto", "on", "off"),
+                        default="auto",
+                        help="derive pre-crack PMKs on the accelerator: "
+                             "auto engages only on a real TPU; the host "
+                             "oracle fallback is bit-identical")
+        sp.add_argument("--precrack-limit", type=int, default=100,
+                        help="max unprocessed nets per pre-crack sweep")
+        sp.add_argument("--precrack-dict-limit", type=int, default=64,
+                        help="top-N cracked-corpus passwords replayed per "
+                             "pre-crack sweep (0 disables the dict source)")
+        sp.add_argument("--no-precrack-ingest", action="store_true",
+                        help="don't sweep new nets synchronously at "
+                             "capture ingestion (the recurring job still "
+                             "covers them on --precrack-interval)")
 
     sp = sub.add_parser("serve", help="run the HTTP API + UI")
     common(sp)

@@ -18,8 +18,11 @@ and work API (web/content/get_work.php, put_work.php), re-homed on sqlite:
   cascade-deleted;
 - maintenance & keygen jobs live in jobs.py.
 
-Verification runs the pure-Python oracle per claim (claims are rare); bulk
-device verification belongs to the client side.
+Every verify loop routes through ``precrack.verify_batch`` (lint rule
+DW115): PBKDF2 for a whole claim/sibling wave derives in one batched
+dispatch, and each verdict is finished by the pure-Python oracle with the
+derived PMK injected — bit-identical to the per-candidate oracle, on host
+or device.
 """
 
 import base64
@@ -35,6 +38,7 @@ from ..models import hashline as hl
 from ..oracle import m22000 as oracle
 from ..utils.fsio import fsync_replace
 from .db import Database, mac2long, now
+from .precrack import PmkBatcher, verify_batch
 
 MAX_CANDS_PER_PUT = 200     # put_work cap (reference: common.php:937)
 MAX_DICTCOUNT = 15          # dictcount clamp (get_work.php:41-46)
@@ -228,6 +232,15 @@ class ServerCore:
         self._m_overload = self.registry.counter(
             "dwpa_server_overload_rejects_total",
             "get_work requests shed by the in-flight lease cap (HTTP 429)")
+        # The batched-verify seam (precrack.verify_batch) every accept /
+        # ingest / replay verdict goes through.  Store-less and host-mode
+        # by default: claim verdicts stay bit-identical to the scalar
+        # oracle with no cache trust involved.
+        self.verifier = PmkBatcher(device="off")
+        # Optional PrecrackEngine (server/__main__ wires it when the
+        # pre-crack job is enabled): when set, add_hashlines sweeps
+        # freshly ingested nets immediately after the commit.
+        self.precrack = None
         self.dictdir = dictdir
         self.capdir = capdir
         # Upload size bound for captures (raw AND gzip-decompressed);
@@ -304,10 +317,18 @@ class ServerCore:
         The whole batch — per-line net inserts plus the user association
         — commits as ONE transaction: a crash mid-ingestion leaves no
         half-recorded submission (nets without their n2u rows, or a
-        partial batch that would double-count on replay).
+        partial batch that would double-count on replay).  When a
+        pre-crack engine is wired (``self.precrack``), fresh nets get
+        their fused candidate sweep immediately after the commit — the
+        sweep takes its own locks/transactions, so it must never run
+        inside this one.
         """
         with self.db.tx():
-            return self._add_hashlines_tx(lines, s_id, ip, userkey)
+            report = self._add_hashlines_tx(lines, s_id, ip, userkey)
+        new_ids = report.pop("new_ids")
+        if self.precrack is not None and new_ids:
+            self.precrack.on_ingest(new_ids)
+        return report
 
     def _add_hashlines_tx(self, lines, s_id, ip, userkey) -> dict:
         report = {"new": 0, "dup": 0, "bad": 0, "precracked": 0}
@@ -329,19 +350,22 @@ class ServerCore:
             n_state, passb, pmk, algo, nc, endian = 0, None, None, None, None, None
             # zero-PMK probe: some broken APs derive everything from an
             # all-zero PMK (ingest-time check, common.php:592-600)
-            z = oracle.check_key_m22000(h, [b""], pmk=b"\x00" * 32, nc=SERVER_NC)
+            z = verify_batch([(h, [b""], b"\x00" * 32)], nc=SERVER_NC,
+                             batcher=self.verifier)[0]
             if z:
                 n_state, passb, pmk, algo = 1, b"", z[3], "ZeroPMK"
                 nc, endian = z[1] or 0, z[2] or ""
                 report["precracked"] += 1
             else:
                 # cross-crack: replay PMKs of cracked siblings (same ssid /
-                # bssid / mac_sta) before volunteers ever see this net
-                for sib in self._handshakes_like(h, n_state=1):
-                    if sib["pmk"] is None:
-                        continue
-                    r = oracle.check_key_m22000(h, [sib["pass"] or b""],
-                                                pmk=sib["pmk"], nc=SERVER_NC)
+                # bssid / mac_sta) before volunteers ever see this net —
+                # every sibling hash verified in ONE batched dispatch
+                sibs = [s for s in self._handshakes_like(h, n_state=1)
+                        if s["pmk"] is not None]
+                checks = verify_batch(
+                    [(h, [s["pass"] or b""], s["pmk"]) for s in sibs],
+                    nc=SERVER_NC, batcher=self.verifier)
+                for sib, r in zip(sibs, checks):
                     if r:
                         n_state = 1
                         passb, nc, endian, pmk = sib["pass"], r[1] or 0, r[2] or "", r[3]
@@ -362,6 +386,9 @@ class ServerCore:
                 new_ids.append(cur.lastrowid)
         if userkey and new_ids:
             self.associate_user(userkey, new_ids)
+        # internal: popped by add_hashlines before the report leaves the
+        # core (feeds the post-commit pre-crack ingestion sweep)
+        report["new_ids"] = new_ids
         return report
 
     def add_probe_requests(self, ssids, s_id: int):
@@ -616,6 +643,7 @@ class ServerCore:
             return False
         with self._getwork_lock:
             with self.db.tx():
+                claims = []
                 for pair in cands[:MAX_CANDS_PER_PUT]:
                     k, v = pair.get("k"), pair.get("v")
                     if not isinstance(k, str) or not isinstance(v, str) or v == "":
@@ -631,6 +659,18 @@ class ServerCore:
                             continue
                     else:
                         psk = oracle.hc_unhex(v)
+                    claims.append((k, psk))
+                # Pre-derive the claim x net PBKDF2 superset in ONE
+                # batched dispatch.  The accept cascade below re-queries
+                # per claim, and accepts only REMOVE nets from n_state=0,
+                # so its queries return subsets of this snapshot — a
+                # superset pair costs one spare derivation, never a
+                # verdict change (verify_batch single-derives any gap).
+                self.verifier.prewarm(
+                    [(net["ssid"], oracle.hc_unhex(psk))
+                     for k, psk in claims
+                     for net in self._nets_for_claim(ctype, k)])
+                for k, psk in claims:
                     for net in self._nets_for_claim(ctype, k):
                         self._try_accept(net, psk, submitter=data.get("ip", ""))
                 if hkey:
@@ -693,9 +733,13 @@ class ServerCore:
         return []
 
     def _try_accept(self, net, psk: bytes, submitter: str = ""):
-        """Independent re-verification + PMK-reuse sweep."""
+        """Independent re-verification + PMK-reuse sweep, both through
+        the batched verify seam (verdicts bit-identical to the scalar
+        oracle: verify_batch finishes every verdict with the oracle
+        itself, PMK injected)."""
         h = hl.parse(net["struct"])
-        r = oracle.check_key_m22000(h, [psk], nc=SERVER_NC)
+        r = verify_batch([(h, [psk], None)], nc=SERVER_NC,
+                         batcher=self.verifier)[0]
         if not r:
             self._m_claims.labels(verdict="rejected").inc()
             return False
@@ -703,9 +747,12 @@ class ServerCore:
         psk_b, nc, endian, pmk = r
         self._mark_cracked(net["net_id"], psk_b, pmk, nc or 0, endian or "")
         # replay this PMK against uncracked siblings (common.php:916-932)
-        for sib in self._handshakes_like(h, n_state=0):
-            sh = hl.parse(sib["struct"])
-            rr = oracle.check_key_m22000(sh, [psk_b], pmk=pmk, nc=SERVER_NC)
+        # — every sibling hash checked in ONE verify dispatch
+        sibs = self._handshakes_like(h, n_state=0)
+        parsed = [hl.parse(s["struct"]) for s in sibs]
+        replays = verify_batch([(sh, [psk_b], pmk) for sh in parsed],
+                               nc=SERVER_NC, batcher=self.verifier)
+        for sib, sh, rr in zip(sibs, parsed, replays):
             if not rr:
                 continue
             if sh.essid == h.essid:

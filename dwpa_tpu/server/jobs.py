@@ -8,6 +8,10 @@
   verify each candidate with the oracle, and finally set ``algo`` (''
   when nothing matched), which *releases* the net to the get_work
   scheduler (get_work only serves algo='' nets, get_work.php:65,101);
+- ``precrack``: the batched superset of ``keygen_precompute``
+  (server/precrack.py): vendor packs + IMEI sweeps + Single/Pattern +
+  cracked-corpus dictionary + cross-net replay, derived as one fused
+  mixed-ESSID device batch and demuxed per net;
 - ``geolocate``: wigle.php/3wifi.php equivalent, behind a pluggable
   lookup function (this environment has zero egress; the reference calls
   external HTTP APIs with throttles).
@@ -25,9 +29,13 @@ from ..gen.dicts import md5_file
 from ..gen.psktool import psk_candidates
 from ..gen.vendors import vendor_candidates
 from ..models import hashline as hl
+from ..obs import get_logger
 from ..oracle import m22000 as oracle
 from .core import LEASE_REAP_S, LEASE_RETENTION_S, SERVER_NC, ServerCore
 from .db import long2mac
+from .precrack import PrecrackEngine
+
+_log = get_logger(__name__)
 
 
 def _job_timer(core: ServerCore, job: str):
@@ -187,6 +195,11 @@ def regen_rkg_dict(core: ServerCore, path: str) -> int:
     their cracked/rkg pass 1, and registering it would double-issue the
     same words through the scheduler.  ORDER BY keeps the bytes (and so
     any cached copy) stable when the word set hasn't changed.
+
+    Skips the gzip -9 rewrite when the word set is unchanged since the
+    last regeneration: the content signature (63-bit blake2b of the
+    uncompressed blob) is kept in the stats table, so every keygen hit
+    on an already-known vendor key stops costing a full recompression.
     """
     rows = core.db.q(
         """SELECT DISTINCT pass FROM nets
@@ -194,10 +207,20 @@ def regen_rkg_dict(core: ServerCore, path: str) -> int:
            ORDER BY pass"""
     )
     words = [r["pass"] for r in rows]
+    data = b"\n".join(words) + (b"\n" if words else b"")
+    # 63-bit signature: the stats table stores sqlite INTEGERs (signed
+    # 64-bit); 0 is reserved for "never generated"
+    sig = (int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          "big") >> 1) or 1
+    if core.db.get_stat("rkg_dict_sig") == sig and os.path.exists(path):
+        _log.info("rkg dict unchanged (%d words, sig %x) — skipping "
+                  "gzip rewrite of %s", len(words), sig, path)
+        return len(words)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "wb") as f:
         with gzip.GzipFile(fileobj=f, mode="wb", compresslevel=9, mtime=0) as gz:
-            gz.write(b"\n".join(words) + (b"\n" if words else b""))
+            gz.write(data)
+    core.db.set_stat("rkg_dict_sig", sig)
     return len(words)
 
 
@@ -253,13 +276,18 @@ def _keygen_precompute(core: ServerCore, limit, extra_generators) -> dict:
         # ONE transaction per net: the rkg attempt rows, the crack mark
         # and the algo release commit together — a kill mid-net leaves
         # it fully unprocessed (algo still NULL), never half-recorded.
-        tried, hit = [], None
-        for algo, cand in cands:
-            tried.append((algo, cand))
-            r = oracle.check_key_m22000(h, [cand], nc=SERVER_NC)
-            if r:
-                hit = (algo, cand, r)
-                break
+        # ONE oracle call per net: the oracle walks the key list with
+        # identical first-match-wins semantics to the old per-candidate
+        # loop, and the hit index recovers the tried prefix (the rkg
+        # attempt rows the scalar loop would have recorded).
+        tried, hit = list(cands), None
+        keys = [c for _, c in cands]
+        r = oracle.check_key_m22000(h, keys, nc=SERVER_NC) if keys else None
+        if r:
+            i = next(i for i, k in enumerate(keys)
+                     if oracle.hc_unhex(k) == r[0])
+            tried = cands[:i + 1]
+            hit = (cands[i][0], cands[i][1], r)
         hit_algo = hit[0] if hit else ""
         with core._getwork_lock:
             with db.tx():
@@ -288,6 +316,26 @@ def _keygen_precompute(core: ServerCore, limit, extra_generators) -> dict:
         # volunteer tries known default keys everywhere (rkg.php:178-197)
         regen_rkg_dict(core, os.path.join(core.dictdir, "rkg.txt.gz"))
     return {"processed": len(nets), "cracked": found}
+
+
+def precrack(core: ServerCore, limit: int = 100, batch: int = 2048,
+             device: str = "auto", store=None, dict_limit: int = 64,
+             imei_limit: int = None) -> dict:
+    """The batched pre-crack sweep (server/precrack.py) as a cron job.
+
+    A superset of ``keygen_precompute``: the same candidate families plus
+    the cracked-corpus dictionary and cross-net replay, derived as ONE
+    fused mixed-ESSID batch (device when available, host PBKDF2
+    otherwise).  The engine is cached on the core (``core.precrack``) so
+    the recurring job and the ingestion hook share one PMK memo/store,
+    and records its own ``job:precrack`` span.
+    """
+    eng = core.precrack
+    if eng is None:
+        eng = core.precrack = PrecrackEngine(
+            core, batch=batch, device=device, store=store,
+            dict_limit=dict_limit, imei_limit=imei_limit)
+    return eng.run(limit=limit)
 
 
 class LookupUnavailable(Exception):

@@ -28,10 +28,10 @@ import re
 
 from ..models import hashline as hl
 from ..obs import get_logger
-from ..oracle import m22000 as oracle
 from .capture import extract_hashlines
 from .core import SERVER_NC, ServerCore
 from .db import long2mac
+from .precrack import verify_batch
 
 # child of the package logger: one setup_logging() config for every
 # emitter (obs/logs.py), ops warnings included
@@ -56,21 +56,25 @@ def recrack_verify(core: ServerCore, limit: int = None) -> dict:
     if limit:
         q += " LIMIT ?"
         args = (limit,)
-    checked = 0
-    for net in core.db.q(q, args):
+    nets = core.db.q(q, args)
+    # One batched dispatch for the whole table: non-empty passes derive
+    # their PBKDF2 in the fused wave, ZeroPMK rows replay the stored PMK
+    # — verdicts identical to the old per-net oracle loop.
+    items = []
+    for net in nets:
         h = hl.parse(net["struct"])
         if net["pass"]:
-            r = oracle.check_key_m22000(h, [net["pass"]], nc=SERVER_NC)
+            items.append((h, [net["pass"]], None))
         else:
-            r = oracle.check_key_m22000(h, [net["pass"] or b""],
-                                        pmk=net["pmk"], nc=SERVER_NC)
+            items.append((h, [net["pass"] or b""], net["pmk"]))
+    for net, r in zip(nets, verify_batch(items, nc=SERVER_NC,
+                                         batcher=core.verifier)):
         if r is None or (net["pmk"] is not None and r[3] != net["pmk"]):
             raise RecrackError(
                 f"net {net['net_id']} ({long2mac(net['bssid']).hex()}): "
                 f"stored pass/pmk does not re-crack its hashline"
             )
-        checked += 1
-    return {"checked": checked}
+    return {"checked": len(nets)}
 
 
 def _read_words(path: str):

@@ -1335,6 +1335,73 @@ def test_dw114_real_server_tree_is_clean():
         assert [v for v in vs if v.code == "DW114"] == [], name
 
 
+# ---------------------------------------------------------------------------
+# DW115: server-side scalar candidate verification
+# ---------------------------------------------------------------------------
+
+
+def test_dw115_flags_scalar_verify_loop():
+    """The seeded failure mode: one full PBKDF2 per loop iteration on a
+    server thread — the shape the precrack verify_batch seam replaces."""
+    src = """
+        def sweep(h, cands, nc):
+            for cand in cands:
+                r = oracle.check_key_m22000(h, [cand], nc=nc)
+                if r:
+                    return r
+    """
+    vs = lint(src, "dwpa_tpu/server/jobs.py")
+    assert codes(vs) == ["DW115"]
+    assert "verify_batch" in vs[0].detail
+    # out of scope: the sanctioned host-oracle fallback seam, and any
+    # non-server path (the client's crack loop batches on device)
+    assert lint(src, "dwpa_tpu/server/precrack.py") == []
+    assert lint(src, "dwpa_tpu/client/main.py") == []
+
+
+def test_dw115_batched_and_unlooped_calls_stay_clean():
+    """The compliant idioms: the whole candidate list in ONE oracle call
+    (keygen_precompute's shape — the oracle scans it internally), and a
+    single scalar call outside any loop (a one-claim verify)."""
+    assert lint("""
+        def keygen(h, cands, nc):
+            for _ in range(2):
+                keys = [c for _, c in cands]
+                r = oracle.check_key_m22000(h, keys, nc=nc)
+            return r
+
+        def verify_one(h, psk, nc):
+            return oracle.check_key_m22000(h, [psk], nc=nc)
+    """, "dwpa_tpu/server/core.py") == []
+
+
+def test_dw115_nested_loops_flag_each_site_once():
+    """A call under two loops is one hazard site, not two (the walk
+    visits it from both loop roots; the node set dedups)."""
+    vs = lint("""
+        def sweep(nets, nc):
+            for h in nets:
+                while pending(h):
+                    r = oracle.check_key_m22000(h, [next_cand(h)], nc=nc)
+    """, "dwpa_tpu/server/tools.py")
+    assert codes(vs) == ["DW115"]
+
+
+def test_dw115_real_server_tree_is_clean():
+    """The refactored server package routes every candidate sweep
+    through verify_batch / the precrack engine (the PR's whole point)."""
+    import os
+
+    root = repo_root()
+    server = os.path.join(root, "dwpa_tpu", "server")
+    for name in sorted(os.listdir(server)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(server, name), encoding="utf-8") as f:
+            vs = lint_source(f.read(), f"dwpa_tpu/server/{name}")
+        assert [v for v in vs if v.code == "DW115"] == [], name
+
+
 def test_full_tree_clean_under_checked_in_baseline():
     """The acceptance gate: ``python -m dwpa_tpu.analysis`` exits 0 on
     this tree with the checked-in baseline — every hot-path sync is
@@ -1346,8 +1413,9 @@ def test_full_tree_clean_under_checked_in_baseline():
 
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
-             "DW108", "DW109", "DW111", "DW112", "DW113", "DW114", "DW201",
-             "DW202", "DW203", "DW204", "DW301", "DW302", "DW303", "DW304"}
+             "DW108", "DW109", "DW111", "DW112", "DW113", "DW114", "DW115",
+             "DW201", "DW202", "DW203", "DW204", "DW301", "DW302", "DW303",
+             "DW304"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

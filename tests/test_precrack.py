@@ -1,0 +1,430 @@
+"""Differential parity suite for the batched server-side pre-crack.
+
+The whole contract of ``server/precrack.py`` is that batching changes
+WHERE the PBKDF2 work happens, never WHAT any verdict is: every test
+here compares the batched path against the per-candidate oracle (or
+against ``keygen_precompute``, the scalar sweep it supersedes) and
+demands bit-identical results — on the host path, on the forced-jax
+device path, with a store, with a poisoned cache, and across an
+injected mid-sweep crash.
+"""
+
+import gzip
+import logging
+import os
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.chaos.dbfault import (DbFaultPlan, SimulatedCrash, install,
+                                    sweep_invariants)
+from dwpa_tpu.models import hashline as hl
+from dwpa_tpu.obs import MetricsRegistry
+from dwpa_tpu.oracle import m22000 as oracle
+from dwpa_tpu.server import Database, ServerCore
+from dwpa_tpu.server.core import SERVER_NC
+from dwpa_tpu.server.jobs import keygen_precompute, precrack, regen_rkg_dict
+from dwpa_tpu.server.precrack import PmkBatcher, PrecrackEngine, verify_batch
+
+PSK = b"precrack-psk"
+ESSID = b"PrecrackLan"
+
+
+@pytest.fixture
+def core(tmp_path):
+    db = Database(":memory:")
+    return ServerCore(db, dictdir=str(tmp_path / "dicts"),
+                      capdir=str(tmp_path / "caps"),
+                      registry=MetricsRegistry())
+
+
+def _single_hit_line(i: int) -> str:
+    """A net the Single generator cracks (ssid.lower() + "1")."""
+    essid = b"PrecrackNet%02d" % i
+    return tfx.make_eapol_line(essid.lower() + b"1", essid,
+                               keyver=2, seed="pc%02d" % i)
+
+
+# ---------------------------------------------------------------------------
+# verify_batch: bit-identity against the per-candidate oracle
+# ---------------------------------------------------------------------------
+
+
+def _mixed_items():
+    """Oracle items across keyvers, hash types, $HEX keys, wrong keys,
+    multi-key lists and an injected first-key PMK."""
+    hexed = b"$HEX[" + PSK.hex().encode() + b"]"
+    items = [
+        (tfx.make_pmkid_line(PSK, ESSID, seed="vb-p"),
+         [b"not-the-psk", PSK], None),
+        (tfx.make_eapol_line(PSK, ESSID, keyver=1, seed="vb-1"),
+         [PSK], None),
+        (tfx.make_eapol_line(PSK, b"OtherLanHere", keyver=2, seed="vb-2"),
+         [b"miss-one00", b"miss-two00", PSK], None),
+        (tfx.make_eapol_line(PSK, ESSID, keyver=3, seed="vb-3"),
+         [hexed], None),
+        (tfx.make_eapol_line(PSK, ESSID, keyver=2, nc_delta=3, seed="vb-n"),
+         [b"all", b"of-these0", b"are-wrong"], None),
+        (tfx.make_eapol_line(PSK, ESSID, keyver=2, seed="vb-i"),
+         [PSK, b"never-reached"], oracle.pmk_from_psk(PSK, ESSID)),
+        # out-of-range word lengths (the host-only oddball path)
+        (tfx.make_pmkid_line(PSK, ESSID, seed="vb-o"),
+         [b"short", b"x" * 70, PSK], None),
+    ]
+    return items
+
+
+def test_verify_batch_matches_oracle_over_mixed_items():
+    items = _mixed_items()
+    got = verify_batch(items, nc=SERVER_NC)
+    want = [oracle.check_key_m22000(line, keys, pmk=pmk, nc=SERVER_NC)
+            for line, keys, pmk in items]
+    assert got == want
+    # the suite must exercise both hit and miss verdicts to mean much
+    assert any(r is not None for r in want)
+    assert any(r is None for r in want)
+
+
+def test_verify_batch_device_path_is_bit_identical():
+    """device="on" forces the fused jax kernel even on CPU: verdicts
+    (and the PMK element each returns) must not change."""
+    items = _mixed_items()
+    got = verify_batch(items, nc=SERVER_NC,
+                       batcher=PmkBatcher(device="on", batch=8))
+    want = [oracle.check_key_m22000(line, keys, pmk=pmk, nc=SERVER_NC)
+            for line, keys, pmk in items]
+    assert got == want
+
+
+def test_verify_batch_accepts_parsed_hashlines_and_empty():
+    h = hl.parse(tfx.make_pmkid_line(PSK, ESSID, seed="vb-h"))
+    assert verify_batch([], nc=SERVER_NC) == []
+    got = verify_batch([(h, [PSK], None)], nc=SERVER_NC)
+    assert got == [oracle.check_key_m22000(h, [PSK], nc=SERVER_NC)]
+
+
+def test_batcher_store_roundtrip(tmp_path):
+    """Fresh derivations land in the store; a second batcher re-reads
+    them (store_hits) and still returns hashlib-exact PMKs."""
+    from dwpa_tpu.pmkstore import PMKStore
+
+    pairs = [(b"StoreNetA", b"storeword%02d" % i) for i in range(5)]
+    pairs += [(b"StoreNetB", b"storeword%02d" % i) for i in range(3)]
+    store = PMKStore(str(tmp_path / "pmks"))
+    b1 = PmkBatcher(store=store, device="off")
+    s1 = b1.prewarm(pairs)
+    assert s1["unique"] == len(pairs) and s1["store_hits"] == 0
+    b2 = PmkBatcher(store=store, device="off")
+    s2 = b2.prewarm(pairs)
+    assert s2["store_hits"] == len(pairs) and s2["derived"] == 0
+    for e, w in pairs:
+        assert b2.pmk(e, w) == oracle.pmk_from_psk(w, e)
+
+
+# ---------------------------------------------------------------------------
+# PrecrackEngine vs keygen_precompute: the differential sweep
+# ---------------------------------------------------------------------------
+
+
+def _net_rows(core):
+    return [(r["net_id"], r["pass"], r["pmk"], r["nc"], r["endian"],
+             r["algo"], r["n_state"])
+            for r in core.db.q("SELECT * FROM nets ORDER BY net_id")]
+
+
+def _rkg_rows(core):
+    return [(r["net_id"], r["algo"], r["pass"], r["n_state"])
+            for r in core.db.q("SELECT * FROM rkg ORDER BY net_id, pass")]
+
+
+def _ingest_fleet(core):
+    lines = [_single_hit_line(i) for i in range(3)]
+    # one net no generator cracks (released with algo = '')
+    lines.append(tfx.make_eapol_line(b"genuinely-random-psk!", b"NoVendorLan",
+                                     keyver=2, seed="pc-miss"))
+    core.add_hashlines(lines)
+
+
+def test_engine_matches_keygen_precompute(tmp_path):
+    """The tentpole differential: over the same nets, the fused engine
+    (replay/dict sources disabled) must write the exact rows the scalar
+    keygen sweep writes — same cracked set, same rkg attempt prefixes,
+    same algo release column."""
+    a = ServerCore(Database(":memory:"), dictdir=str(tmp_path / "da"),
+                   capdir=str(tmp_path / "ca"), registry=MetricsRegistry())
+    b = ServerCore(Database(":memory:"), dictdir=str(tmp_path / "db"),
+                   capdir=str(tmp_path / "cb"), registry=MetricsRegistry())
+    _ingest_fleet(a)
+    _ingest_fleet(b)
+
+    ra = keygen_precompute(a)
+    eng = PrecrackEngine(b, device="off", dict_limit=0)
+    rb = eng.run()
+    assert ra["processed"] == rb["processed"] == 4
+    assert ra["cracked"] == rb["cracked"] == 3
+    assert _net_rows(a) == _net_rows(b)
+    assert _rkg_rows(a) == _rkg_rows(b)
+    # both regenerated the same vendor-key dictionary
+    with open(os.path.join(a.dictdir, "rkg.txt.gz"), "rb") as f:
+        da = f.read()
+    with open(os.path.join(b.dictdir, "rkg.txt.gz"), "rb") as f:
+        db_ = f.read()
+    assert da == db_
+
+
+def test_engine_replay_and_dict_sources(core):
+    """The server-only sources: a cracked sibling's PSK replays onto a
+    same-ESSID net (its stored PMK seeded — zero extra PBKDF2), and the
+    cracked corpus replays as a dictionary onto unrelated nets."""
+    secret = b"not-any-vendor-key"
+    l1 = tfx.make_eapol_line(secret, ESSID, keyver=2, seed="rp1")
+    # same ESSID, different station -> replay source; different ESSID
+    # -> only the dict source can reach it
+    l2 = tfx.make_eapol_line(secret, ESSID, keyver=2, seed="rp2")
+    l3 = tfx.make_pmkid_line(secret, b"UnrelatedLan", seed="rp3")
+    core.add_hashlines([l1, l2, l3])
+
+    # crack l1 out-of-band (straight SQL, NOT _try_accept — that would
+    # replay onto l2 right here and leave nothing for the engine)
+    net = core.db.q1("SELECT net_id FROM nets WHERE ssid = ? "
+                     "ORDER BY net_id", (ESSID,))
+    core.db.x(
+        "UPDATE nets SET pass = ?, pmk = ?, n_state = 1, algo = 'Manual' "
+        "WHERE net_id = ?",
+        (secret, oracle.pmk_from_psk(secret, ESSID), net["net_id"]))
+
+    eng = PrecrackEngine(core, device="off")
+    out = eng.run()
+    assert out["processed"] == 2 and out["cracked"] == 2
+    rows = core.db.q("SELECT algo, pass, n_state FROM nets "
+                     "WHERE algo != 'Manual' ORDER BY net_id")
+    assert [(r["algo"], r["pass"], r["n_state"]) for r in rows] == [
+        ("Replay", secret, 1), ("Dict", secret, 1)]
+    reg = core.registry
+    assert reg.value("dwpa_precrack_candidates_total", source="replay") >= 1
+    assert reg.value("dwpa_precrack_candidates_total", source="dict") >= 1
+    assert reg.value("dwpa_precrack_free_founds_total") == 2
+
+
+def test_engine_empty_candidate_net(core, monkeypatch):
+    """A net with literally zero candidates is still RELEASED (algo '')
+    — pre-crack must never wedge a net out of the volunteer queue."""
+    import dwpa_tpu.gen.psktool as psktool
+    import dwpa_tpu.server.jobs as jobs_mod
+
+    core.add_hashlines([tfx.make_eapol_line(PSK, ESSID, keyver=2,
+                                            seed="empty")])
+    monkeypatch.setattr(jobs_mod, "single_mode_candidates",
+                        lambda bssid, ssid: [])
+    monkeypatch.setattr(psktool, "psk_candidates",
+                        lambda essid, mac_ap, mac_sta=None: [])
+    eng = PrecrackEngine(core, device="off", generators=[], dict_limit=0)
+    out = eng.run()
+    assert out == {"processed": 1, "cracked": 0, "candidates": 0}
+    row = core.db.q1("SELECT algo, n_state FROM nets")
+    assert row["algo"] == "" and row["n_state"] == 0
+    assert core.db.q1("SELECT COUNT(*) c FROM rkg")["c"] == 0
+    # nothing left to process: the next run is a no-op
+    assert eng.run() == {"processed": 0, "cracked": 0, "candidates": 0}
+
+
+def test_poisoned_pmk_is_a_miss_never_an_accept(core):
+    """Trust boundary: a wrong PMK planted in the cache can only turn a
+    would-be hit into a miss (net stays uncracked, still released); it
+    can never manufacture an accept.  Clearing the poison re-cracks."""
+    core.add_hashlines([_single_hit_line(7)])
+    essid = b"PrecrackNet07"
+    right = essid.lower() + b"1"
+
+    eng = PrecrackEngine(core, device="off", dict_limit=0)
+    for w in (right, b"some-wrong-word"):
+        eng.batcher.seed(essid, w, b"\xee" * 32)
+    out = eng.run()
+    assert out["cracked"] == 0
+    row = core.db.q1("SELECT algo, n_state, pass FROM nets")
+    assert row["n_state"] == 0 and row["pass"] is None
+    assert row["algo"] == ""  # released despite the poisoned miss
+
+    core.db.x("UPDATE nets SET algo = NULL")
+    core.db.x("DELETE FROM rkg")
+    clean = PrecrackEngine(core, device="off", dict_limit=0)
+    assert clean.run()["cracked"] == 1
+    assert core.db.q1("SELECT pass FROM nets")["pass"] == right
+
+
+def test_mid_sweep_crash_keeps_nets_atomic(tmp_path):
+    """Chaos: crash the core at a statement seam inside the LAST net's
+    transaction.  Earlier nets stay fully committed, the interrupted net
+    stays fully unprocessed (algo NULL, no rkg rows), the invariant
+    sweep is clean, and a rerun converges to the exact no-crash state."""
+
+    def build(tag):
+        c = ServerCore(Database(":memory:"),
+                       dictdir=str(tmp_path / ("d" + tag)),
+                       capdir=str(tmp_path / ("c" + tag)),
+                       registry=MetricsRegistry())
+        c.add_hashlines([_single_hit_line(i) for i in range(2)])
+        return c
+
+    # recording pass: the statement stream of a healthy sweep, with the
+    # SQL text kept — the fault plan's schedule only logs verbs, and the
+    # post-sweep dictionary regen issues inserts of its own AFTER every
+    # net has committed, so "last insert" must mean "last rkg insert"
+    ref = build("ref")
+    stmts = []
+    real_exec = ref.db._exec
+    ref.db._exec = lambda sql, params=(): (stmts.append(sql),
+                                           real_exec(sql, params))[1]
+    PrecrackEngine(ref, device="off", dict_limit=0).run()
+    ref.db._exec = real_exec
+    inserts = [i for i, sql in enumerate(stmts)
+               if sql.lstrip().lower().startswith("insert into rkg")]
+    assert inserts, "sweep recorded no rkg inserts?"
+
+    # replay pass: crash at the LAST rkg insert — net 1's tx already
+    # committed, net 2's tx is open and must vanish wholesale
+    vic = build("vic")
+    uninstall = install(vic.db, DbFaultPlan(seed=0).force_at(inserts[-1],
+                                                            "crash"))
+    with pytest.raises(SimulatedCrash):
+        PrecrackEngine(vic, device="off", dict_limit=0).run()
+    uninstall()
+    assert sweep_invariants(vic.db) == []
+    rows = vic.db.q("SELECT algo, n_state FROM nets ORDER BY net_id")
+    assert rows[0]["algo"] == "Single" and rows[0]["n_state"] == 1
+    assert rows[1]["algo"] is None and rows[1]["n_state"] == 0
+    assert vic.db.q1(
+        "SELECT COUNT(*) c FROM rkg WHERE net_id = ?",
+        (vic.db.q("SELECT net_id FROM nets ORDER BY net_id")[1]["net_id"],)
+    )["c"] == 0
+
+    # restart: the rerun picks up ONLY the unprocessed net and lands on
+    # the healthy end state
+    assert PrecrackEngine(vic, device="off",
+                          dict_limit=0).run()["cracked"] == 1
+    assert sweep_invariants(vic.db) == []
+    assert _net_rows(vic) == _net_rows(ref)
+    assert _rkg_rows(vic) == _rkg_rows(ref)
+
+
+def test_engine_skips_nets_cracked_mid_sweep(core):
+    """The in-tx re-check: a net accepted between candidate collection
+    and its per-net transaction is left alone (no duplicate rkg rows,
+    no algo overwrite)."""
+    core.add_hashlines([_single_hit_line(9)])
+    eng = PrecrackEngine(core, device="off", dict_limit=0)
+    net = core.db.q1("SELECT * FROM nets")
+
+    real_prewarm = eng.batcher.prewarm
+
+    def racing_prewarm(pairs):
+        # a volunteer submits the right key while the wave derives
+        core._try_accept(net, b"precracknet091")
+        core.db.x("UPDATE nets SET algo = 'Volunteer' WHERE net_id = ?",
+                  (net["net_id"],))
+        return real_prewarm(pairs)
+
+    eng.batcher.prewarm = racing_prewarm
+    out = eng.run()
+    assert out["processed"] == 1 and out["cracked"] == 0
+    row = core.db.q1("SELECT algo, n_state FROM nets")
+    assert row["algo"] == "Volunteer" and row["n_state"] == 1
+    assert core.db.q1("SELECT COUNT(*) c FROM rkg")["c"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ingestion hook + cron wiring
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_hook_precracks_new_nets(core):
+    """With an engine wired on the core, a freshly ingested net arrives
+    already cracked — no cron tick, no volunteer lease."""
+    core.precrack = PrecrackEngine(core, device="off", dict_limit=0)
+    report = core.add_hashlines([_single_hit_line(4)])
+    assert report["new"] == 1
+    row = core.db.q1("SELECT pass, algo, n_state FROM nets")
+    assert row["n_state"] == 1 and row["algo"] == "Single"
+    assert row["pass"] == b"precracknet041"
+    # ingest report shape is unchanged by the hook plumbing
+    assert "new_ids" not in report
+
+
+def test_precrack_job_caches_engine_on_core(core):
+    core.add_hashlines([_single_hit_line(5)])
+    out = precrack(core, device="off", dict_limit=0)
+    assert out["processed"] == 1 and out["cracked"] == 1
+    assert isinstance(core.precrack, PrecrackEngine)
+    eng = core.precrack
+    # second tick reuses the engine (shared memo/store) and is a no-op
+    assert precrack(core, device="off", dict_limit=0)["processed"] == 0
+    assert core.precrack is eng
+    assert core.registry.value("dwpa_span_seconds",
+                               span="job:precrack") == 2
+
+
+# ---------------------------------------------------------------------------
+# satellites: keygen batching + rkg dict regeneration skip
+# ---------------------------------------------------------------------------
+
+
+def test_keygen_makes_one_oracle_call_per_net(core, monkeypatch):
+    """Satellite: keygen_precompute now hands the oracle each net's
+    whole candidate list at once — N nets, N calls — and still records
+    the scalar loop's tried-prefix rkg rows."""
+    import dwpa_tpu.server.jobs as jobs_mod
+
+    mac = bytes.fromhex("aabbccddeeff")
+    # first Single candidate (bssid 12-hex, delta 0) is the PSK: the
+    # tried prefix must collapse to exactly one rkg row
+    lines = [tfx.make_eapol_line(b"aabbccddeeff", b"FirstCandLan",
+                                 keyver=2, seed="kg1", mac_ap=mac),
+             _single_hit_line(6)]
+    core.add_hashlines(lines)
+
+    calls = []
+    real = oracle.check_key_m22000
+
+    def counting(line, keys, **kw):
+        calls.append(len(list(keys)))
+        return real(line, keys, **kw)
+
+    monkeypatch.setattr(jobs_mod.oracle, "check_key_m22000", counting)
+    out = keygen_precompute(core)
+    assert out == {"processed": 2, "cracked": 2}
+    assert len(calls) == 2          # ONE oracle call per net
+    assert all(n > 1 for n in calls)
+    first = core.db.q("SELECT * FROM rkg ORDER BY rowid LIMIT 1")[0]
+    assert first["pass"] == b"aabbccddeeff"
+    assert core.db.q1(
+        "SELECT COUNT(*) c FROM rkg WHERE net_id = ?",
+        (first["net_id"],))["c"] == 1
+
+
+def test_regen_rkg_dict_skips_unchanged_rewrite(core, caplog):
+    """Satellite: an unchanged cracked-rkg row set skips the gzip -9
+    rewrite (content signature in the stats table) and logs the skip;
+    a new cracked row invalidates the signature and rewrites."""
+    core.add_hashlines([_single_hit_line(1)])
+    keygen_precompute(core)
+    path = os.path.join(core.dictdir, "rkg.txt.gz")
+    with open(path, "rb") as f:
+        blob = f.read()
+    assert gzip.decompress(blob) == b"precracknet011\n"
+    assert core.db.get_stat("rkg_dict_sig") != 0
+
+    # unchanged word set: the sentinel survives = no rewrite happened
+    with open(path, "wb") as f:
+        f.write(b"sentinel")
+    with caplog.at_level(logging.INFO, logger="dwpa_tpu.server.jobs"):
+        assert regen_rkg_dict(core, path) == 1
+    assert "skipping gzip rewrite" in caplog.text
+    with open(path, "rb") as f:
+        assert f.read() == b"sentinel"
+
+    # a new cracked word changes the signature: full rewrite
+    core.add_hashlines([_single_hit_line(2)])
+    keygen_precompute(core)
+    with open(path, "rb") as f:
+        words = gzip.decompress(f.read())
+    assert words == b"precracknet011\nprecracknet021\n"

@@ -359,6 +359,12 @@ def test_metrics_endpoint_prometheus_scrape(tmp_path):
         "cand": [{"k": bssid, "v": PSK.hex()}]}).encode())
     maintenance(core)
 
+    # batched pre-crack job over one fresh net (Single cracks it)
+    core.add_hashlines([tfx.make_eapol_line(b"metricsnet1", b"MetricsNet",
+                                            keyver=2, seed="mx2")])
+    from dwpa_tpu.server.jobs import precrack
+    assert precrack(core, device="off")["cracked"] == 1
+
     status, body = _call(app, qs="metrics")
     assert status.startswith("200")
     prom = _parse_prometheus(body.decode())
@@ -383,10 +389,20 @@ def test_metrics_endpoint_prometheus_scrape(tmp_path):
               frozenset({("verdict", "accepted")}))] == 1
     # scrape-time lease/net gauges (the unit was accepted: lease closed)
     assert s[("dwpa_server_leases_active", frozenset())] == 0
-    assert s[("dwpa_server_nets", frozenset({("state", "cracked")}))] == 1
+    # both the volunteer claim and the pre-crack found are cracked nets
+    assert s[("dwpa_server_nets", frozenset({("state", "cracked")}))] == 2
     # maintenance-job duration rode the span histogram
     assert s[("dwpa_span_seconds_count",
               frozenset({("span", "job:maintenance")}))] == 1
+    # pre-crack sweep: per-source candidate counters, the free-found
+    # counter, the batch fill gauge and the job span all on one scrape
+    assert prom["types"]["dwpa_precrack_candidates_total"] == "counter"
+    assert s[("dwpa_precrack_candidates_total",
+              frozenset({("source", "single")}))] >= 1
+    assert s[("dwpa_precrack_free_founds_total", frozenset())] == 1
+    assert ("dwpa_precrack_batch_fill_fraction", frozenset()) in s
+    assert s[("dwpa_span_seconds_count",
+              frozenset({("span", "job:precrack")}))] == 1
 
     # the JSON wire form parses and agrees on the counter
     status, body = _call(app, qs="metrics=json")
