@@ -1119,17 +1119,162 @@ def bench_server_precrack(nets: int = 48, group: int = 16,
         s_fused = _timed(lambda: box.update(fused=feng.run(limit=nets)),
                          "bench:server_precrack_fused")
     cands = box["scalar"]["candidates"]
-    return {"label": "server_precrack", "nets": nets, "groups": groups,
-            "candidates": cands,
-            "scalar_seconds": s_scalar, "fused_seconds": s_fused,
-            "scalar_cands_per_s": cands / max(s_scalar, 1e-9),
-            "fused_cands_per_s": cands / max(s_fused, 1e-9),
-            "speedup": s_scalar / max(s_fused, 1e-9),
-            "free_founds": box["fused"]["cracked"],
-            "found_parity": (founds(sc) == founds(fc)
-                             and box["scalar"]["cracked"]
-                             == box["fused"]["cracked"] == groups),
-            "recompiles_warm": comp.count}
+    out = {"label": "server_precrack", "nets": nets, "groups": groups,
+           "candidates": cands,
+           "scalar_seconds": s_scalar, "fused_seconds": s_fused,
+           "scalar_cands_per_s": cands / max(s_scalar, 1e-9),
+           "fused_cands_per_s": cands / max(s_fused, 1e-9),
+           "speedup": s_scalar / max(s_fused, 1e-9),
+           "free_founds": box["fused"]["cracked"],
+           "found_parity": (founds(sc) == founds(fc)
+                            and box["scalar"]["cracked"]
+                            == box["fused"]["cracked"] == groups),
+           "recompiles_warm": comp.count}
+    if not ON_TPU:
+        # device="on" off-accelerator would just re-time the jax CPU
+        # backend; the device-path rate is only meaningful end-to-end
+        out["device_leg"] = "skipped_no_tpu"
+        return out
+    # Attached-device leg: the recurring sweep as operators run it on a
+    # TPU host — device derivations forced on, same candidate stream,
+    # same found set, warm shapes already paid by the auto leg above.
+    dc = build_server()
+    deng = PrecrackEngine(dc, device="on", batch=batch, generators=gens)
+    with watch_compiles() as dcomp:
+        s_dev = _timed(lambda: box.update(dev=deng.run(limit=nets)),
+                       "bench:server_precrack_device")
+    out.update(device_seconds=s_dev,
+               device_cands_per_s=cands / max(s_dev, 1e-9),
+               device_found_parity=(founds(dc) == founds(fc)
+                                    and box["dev"]["cracked"] == groups),
+               device_recompiles_warm=dcomp.count)
+    return out
+
+
+def bench_mask_shards(batch: int = None, words: int = 20_000,
+                      ceiling_pmk_per_s: float = None) -> dict:
+    """bench:mask_shards — server-issued mask-shard unit vs the same
+    keyspace pre-materialized as a dictionary (smart-keyspace vertical).
+
+    Two loopback servers over the SAME 20k-word keyspace
+    ``^benchm[01]\\d{4}$``: the mask leg holds only a ks row, so
+    get_work hands the client a ``dicts: []`` unit whose candidates are
+    generated ON DEVICE from ``(mask, custom, skip, limit)`` alone; the
+    dict leg ships the identical words (odometer order) as a gzipped
+    wordlist.  The PSK is the LAST keyspace word, so both legs sweep
+    the full range before their hit.  Both legs run the full
+    get_work -> crack -> put_work exchange through a byte-counting
+    WSGI transport: ``mask_wire_bytes_per_cand`` must be ~0 (the
+    unit's JSON framing only) while the dict leg pays the wordlist
+    download.  Tracks found parity, the mask leg's rate against the
+    dict leg and against the raw ``bench_mask_pbkdf2`` ceiling
+    (``vs_mask_ceiling``; acceptance floor 0.9), and the warm-path
+    recompile count (must be 0).
+    """
+    import gzip as _gzip
+    import hashlib as _hashlib
+    import tempfile
+
+    from dwpa_tpu.chaos import WsgiTransport
+    from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+    from dwpa_tpu.client.protocol import ServerAPI
+    from dwpa_tpu.gen.mask import mask_words
+    from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+
+    if batch is None:
+        batch = 131072 if ON_TPU else 2048
+    # keyspace = 2 * 10^digits: snap ``words`` to the nearest such size
+    digits = max(1, len(str(max(words, 20) // 2)) - 1)
+    words = 2 * 10 ** digits
+    batch = min(batch, max(256, words // 4))
+    essid = b"bench-maskks"
+    pass_re = r"^benchm[01]\d{%d}$" % digits
+    # the dict leg's wordlist IS the compiled keyspace in odometer order
+    wordlist = list(mask_words("benchm?1" + "?d" * digits, {"1": b"01"}))
+    assert len(wordlist) == words
+    psk = wordlist[-1]
+    blob = _gzip.compress(b"\n".join(wordlist) + b"\n")
+    dhash = _hashlib.md5(blob).hexdigest()
+
+    def build_server(td, leg):
+        core = ServerCore(Database(":memory:"),
+                          dictdir=os.path.join(td, "dicts"),
+                          capdir=os.path.join(td, "caps"))
+        core.add_hashlines([T.make_pmkid_line(psk, essid, seed="maskks1")])
+        core.db.x("UPDATE nets SET algo = ''")
+        if leg == "mask":
+            core.ks_add(r"^bench-maskks$", pass_re)
+        else:
+            os.makedirs(core.dictdir, exist_ok=True)
+            with open(os.path.join(core.dictdir, "ks.txt.gz"), "wb") as f:
+                f.write(blob)
+            core.add_dict("dict/ks.txt.gz", "ks.txt.gz", dhash,
+                          len(wordlist), rules=None)
+        return core
+
+    class CountingTransport(WsgiTransport):
+        """WsgiTransport that meters both wire directions."""
+
+        def __init__(self, app):
+            super().__init__(app)
+            self.wire_bytes = 0
+
+        def __call__(self, url, body=None, headers=None):
+            self.wire_bytes += len(url) + len(body or b"")
+            data = super().__call__(url, body, headers)
+            self.wire_bytes += len(data)
+            return data
+
+    def run_leg(td, leg, span):
+        core = build_server(td, leg)
+        api = ServerAPI("http://loopback/", max_tries=1,
+                        sleep=lambda s: None)
+        api._transport = transport = CountingTransport(make_wsgi_app(core))
+        cfg = ClientConfig(base_url="http://loopback/",
+                           workdir=os.path.join(td, "work"),
+                           batch_size=batch, dictcount=1,
+                           device_streams="off")
+        client = TpuCrackClient(cfg, api=api, log=lambda *a, **k: None)
+        work = client.api.get_work(1)
+        assert (work["dicts"] == []) == (leg == "mask")
+        box = {}
+        s = _timed(lambda: box.setdefault("res", client.process_work(work)),
+                   span)
+        return box["res"], s, transport.wire_bytes, core
+
+    with tempfile.TemporaryDirectory() as td:
+        # warm both trace families off the clock: the on-device mask
+        # generator and the host-packed dict feed
+        run_leg(os.path.join(td, "wm"), "mask", "bench:mask_shards_warmup")
+        run_leg(os.path.join(td, "wd"), "dict", "bench:mask_shards_warmup")
+        with watch_compiles() as comp:
+            mres, mask_s, mask_wire, mcore = run_leg(
+                os.path.join(td, "mask"), "mask", "bench:mask_shards")
+        dres, dict_s, dict_wire, dcore = run_leg(
+            os.path.join(td, "dict"), "dict", "bench:mask_shards_dict")
+
+    mask_rate = mres.candidates_tried / max(mask_s, 1e-9)
+    # both legs also sweep the client's pass-1 SSID-targeted host
+    # candidates (same ESSID -> same count), so tried is words + a few
+    # dozen on each side; parity demands the counts MATCH, not == words
+    parity = ([f.psk for f in mres.founds] == [f.psk for f in dres.founds]
+              == [psk]
+              and mres.candidates_tried == dres.candidates_tried >= words
+              and mcore.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+              and dcore.db.q1("SELECT n_state FROM nets")["n_state"] == 1)
+    out = {"label": "mask_shards", "words": words, "batch": batch,
+           "mask_seconds": mask_s, "dict_seconds": dict_s,
+           "mask_cands_per_s": mask_rate,
+           "dict_cands_per_s": dres.candidates_tried / max(dict_s, 1e-9),
+           "rate_vs_dict": dict_s / max(mask_s, 1e-9),
+           "mask_wire_bytes": mask_wire, "dict_wire_bytes": dict_wire,
+           "mask_wire_bytes_per_cand": mask_wire / words,
+           "dict_wire_bytes_per_cand": dict_wire / words,
+           "found_parity": parity,
+           "recompiles_warm": comp.count}
+    if ceiling_pmk_per_s:
+        out["vs_mask_ceiling"] = mask_rate / ceiling_pmk_per_s
+    return out
 
 
 def _timed(fn, name: str = "bench:timed") -> float:
@@ -1259,6 +1404,7 @@ def main():
     resilience = bench_resilience(batch)
     server_load = bench_server_load()
     server_precrack = bench_server_precrack(batch=batch)
+    mask_shards = bench_mask_shards(batch, ceiling_pmk_per_s=mask["pmk_per_s"])
 
     value = mask["pmk_per_s"]
     print(
@@ -1289,6 +1435,7 @@ def main():
                     "resilience": _round(resilience),
                     "server_load": _round(server_load),
                     "server_precrack": _round(server_precrack),
+                    "mask_shards": _round(mask_shards),
                 },
             }
         )

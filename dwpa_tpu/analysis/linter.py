@@ -141,6 +141,20 @@ Rule codes (stable — referenced by baseline.json and the docs):
   / ``PmkBatcher.prewarm``): PMKs derive once per fused mixed-ESSID
   batch, verdicts still finish through the same oracle call — bit-
   identical results, batch-width fewer PBKDF2 runs per sweep.
+- **DW116 mask-block-seam** — the framed-mask dispatch contract
+  (``STREAM_FILES`` + ``FEED_DIRS`` + the client crack loop and the
+  scheduling layers, ``MASK_SEAM_FILES``/``MASK_SEAM_DIRS``): no
+  ``mask_words``/``device_mask_words`` import or call, and no direct
+  ``MaskPrep(...)`` construction.  Mask keyspace slices travel ONLY as
+  the framed blocks ``gen.mask.mask_blocks`` emits — it derives every
+  block's ``(offset, count)`` from the ``mask_keyspace``-bounded total,
+  so skip/limit resume stays in hashcat ``-s`` coordinates and a
+  hand-rolled enumerator can never silently walk past a shard's
+  ``limit`` or host-materialize candidates the device generator exists
+  to absorb.  ``models/m22000.py`` (the engine's ``_prepare_block``
+  device-generation seam and its scalar probe) and the low-volume
+  targeted host generators (``client/targeted.py``) are outside the
+  scope by design.
 
 The linter is repo-native, not general-purpose: rules are scoped to the
 paths where the hazard matters (see ``HOT_PATH_FILES``/``BENCH_FILES``/
@@ -255,6 +269,15 @@ STREAM_BLOCKING_FETCHES = {"device_get", "block_until_ready"}
 #: ``rr.apply(...)`` flag while ``df.apply(...)``/``pool.apply(...)``
 #: stay clean); the rules-feed scope is STREAM_FILES + FEED_DIRS
 _RULE_RECV = re.compile(r"(?i)(rule|^rr?$)")
+
+#: the framed-mask dispatch scope DW116 polices beyond STREAM_FILES and
+#: FEED_DIRS: the client crack loop and the scheduling layers — every
+#: surface where a mask shard travels as a work unit rather than as the
+#: engine's own device-generation seam
+MASK_SEAM_FILES = ("dwpa_tpu/client/main.py",)
+MASK_SEAM_DIRS = ("dwpa_tpu/sched", "dwpa_tpu/keyspace")
+#: raw enumerators DW116 bans off the mask_blocks seam (import or call)
+MASK_ENUM_NAMES = {"mask_words", "device_mask_words"}
 
 #: files whose [W, 16] row-buffer allocations DW109 polices — the
 #: fused/mixed batch packers that feed per-lane rows to pmk_kernel
@@ -1235,6 +1258,53 @@ def _check_precrack_scalar_verify(tree, path, src_lines, out):
 
 
 # ---------------------------------------------------------------------------
+# DW116: framed-mask dispatch seam
+# ---------------------------------------------------------------------------
+
+
+def _check_mask_block_seam(tree, path, src_lines, out):
+    """DW116: in the mask-dispatch scope, keyspace slices travel only as
+    the framed blocks ``gen.mask.mask_blocks`` emits.
+
+    (a) ``mask_words``/``device_mask_words`` import or call — a raw
+    enumerator on the dispatch path either host-materializes candidates
+    the device generator exists to absorb or re-derives block framing by
+    hand; (b) direct ``MaskPrep(...)`` construction (or its import) — a
+    hand-built prep carries whatever ``start`` the caller typed, while
+    ``mask_blocks`` derives every ``(offset, count)`` from the
+    ``mask_keyspace``-bounded total, keeping skip/limit resume exact in
+    hashcat ``-s`` coordinates."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in MASK_ENUM_NAMES or a.name == "MaskPrep":
+                    out.append(Violation(
+                        "DW116", path, node.lineno,
+                        f"{a.name} imported on the mask-dispatch path — "
+                        "mask shards travel only as mask_blocks' framed "
+                        "MaskPrep blocks (mask_keyspace-derived framing, "
+                        "hashcat -s/-l resume coordinates)",
+                        _line(src_lines, node)))
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in MASK_ENUM_NAMES:
+                out.append(Violation(
+                    "DW116", path, node.lineno,
+                    f"raw mask enumerator {name}() on the mask-dispatch "
+                    "path — frame the slice through gen.mask.mask_blocks "
+                    "and let the engine's _prepare_block seam generate "
+                    "on device", _line(src_lines, node)))
+            elif name == "MaskPrep":
+                out.append(Violation(
+                    "DW116", path, node.lineno,
+                    "direct MaskPrep(...) construction outside "
+                    "gen/mask.py — a hand-built prep bypasses "
+                    "mask_blocks' keyspace-bounded (offset, count) "
+                    "framing; resume offsets drift off hashcat -s "
+                    "coordinates", _line(src_lines, node)))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1273,6 +1343,10 @@ def lint_source(src: str, path: str) -> list:
     if (path in STREAM_FILES
             or path.startswith(tuple(d + "/" for d in FEED_DIRS))):
         _check_rules_device_expansion(tree, path, src_lines, out)
+    if (path in STREAM_FILES or path in MASK_SEAM_FILES
+            or path.startswith(tuple(
+                d + "/" for d in FEED_DIRS + MASK_SEAM_DIRS))):
+        _check_mask_block_seam(tree, path, src_lines, out)
     if path.startswith(CLIENT_DIR) and path != CLIENT_TRANSPORT_FILE:
         _check_client_transport(tree, path, src_lines, out)
     if path.startswith(SERVER_DIR):

@@ -33,6 +33,7 @@ from ..analysis import watch_compiles
 from ..feed import CandidateFeed, DictFeedSource, RulesFeedSource
 from ..feed.framing import frame_blocks
 from ..gen import DictStream, psk_candidates
+from ..gen.mask import mask_blocks
 from ..models import hashline as hl
 from ..models.m22000 import M22000Engine
 from ..obs import (SpanTracer, default_registry, get_logger, is_emitter,
@@ -852,6 +853,11 @@ class TpuCrackClient:
         self._write_resume(work)
         progress = work.pop("_progress", None) or {}
         skip = int(progress.get("done", 0))
+        # Mask shards keep their own progress counter: "done" counts the
+        # pass-1/2 candidate stream, "mask_done" counts mask-keyspace
+        # candidates — mixing them would make the pass-1 fast-forward
+        # skip dict candidates that were never tried.
+        mask_skip = int(progress.get("mask_done", 0))
         if jax.process_count() > 1:
             # Hosts may have checkpointed different done counts before a
             # crash; the pass-2 device path requires an identical skip
@@ -860,10 +866,12 @@ class TpuCrackClient:
             import numpy as _np
             from jax.experimental import multihost_utils
 
-            skip = int(multihost_utils.broadcast_one_to_all(_np.int64(skip)))
-        self._resuming = skip > 0
-        if skip:
-            self._m_resume.inc(skip)
+            agreed = multihost_utils.broadcast_one_to_all(
+                _np.array([skip, mask_skip], _np.int64))
+            skip, mask_skip = int(agreed[0]), int(agreed[1])
+        self._resuming = skip > 0 or mask_skip > 0
+        if skip or mask_skip:
+            self._m_resume.inc(skip + mask_skip)
         if not self._resuming:
             # once per unit: a resume replay must not duplicate the entry
             self._archive_work(work)
@@ -874,17 +882,28 @@ class TpuCrackClient:
         )
         founds = []
         done = skip
+        mask_done = mask_skip
+
+        def _checkpoint():
+            work["_progress"] = {
+                "done": done,
+                "mask_done": mask_done,
+                "cand": prior_cand
+                + [{"k": f.line.mac_ap.hex(), "v": f.psk.hex()} for f in founds],
+            }
+            self._write_resume(work)
 
         def on_batch(consumed, new_founds):
             nonlocal done
             done += consumed
             founds.extend(new_founds)
-            work["_progress"] = {
-                "done": done,
-                "cand": prior_cand
-                + [{"k": f.line.mac_ap.hex(), "v": f.psk.hex()} for f in founds],
-            }
-            self._write_resume(work)
+            _checkpoint()
+
+        def on_mask_batch(consumed, new_founds):
+            nonlocal mask_done
+            mask_done += consumed
+            founds.extend(new_founds)
+            _checkpoint()
 
         # Pass 1 materializes host-side, so its resume fast-forward is
         # the feed's producer-side skip; whatever the window doesn't
@@ -1028,8 +1047,37 @@ class TpuCrackClient:
                         self._crack_blocks(engine, feed2, on_batch=on_batch)
                     finally:
                         feed2.close()
-        tried = done - skip
-        tried2 = tried - tried1
+            # Mask pass: server-issued keyspace shards, generated ON
+            # DEVICE from (mask, custom, skip, limit) alone — zero
+            # candidate bytes arrived on the wire.  mask_blocks frames
+            # each shard as MaskPrep blocks in hashcat -s/-l coordinates
+            # (absolute keyspace offsets), so the mask_done fast-forward
+            # resumes mid-shard bit-identically: a restart replays
+            # exactly ``limit - done`` candidates of the lease's range.
+            mask_entries = work.get("masks") or []
+            if mask_entries:
+                with self.tracer.span("mask") as spm:
+                    mrem = mask_skip
+                    for shard in mask_entries:
+                        mlimit = int(shard["limit"])
+                        if mrem >= mlimit:
+                            mrem -= mlimit  # shard finished pre-restart
+                            continue
+                        custom = {k: v.encode("latin1") for k, v in
+                                  (shard.get("custom") or {}).items()}
+                        blocks = mask_blocks(
+                            shard["mask"], self.cfg.batch_size,
+                            skip=int(shard["skip"]) + mrem,
+                            limit=mlimit - mrem, custom=custom)
+                        mrem = 0
+                        self._crack_blocks(engine, blocks,
+                                           on_batch=on_mask_batch)
+                triedm = mask_done - mask_skip
+                if triedm and spm.seconds > 0:
+                    self._m_pmks.labels(**{"pass": "mask"}).set(
+                        triedm / spm.seconds)
+        tried = (done - skip) + (mask_done - mask_skip)
+        tried2 = done - skip - tried1
         if tried2 and sp2.seconds > 0:
             self._m_pmks.labels(**{"pass": "2"}).set(tried2 / sp2.seconds)
         if comp.count:

@@ -402,6 +402,15 @@ class ServerAPI:
                     if field not in work:
                         raise ValueError(
                             f"malformed work unit: missing {field}")
+                # mask shards are optional; when present each entry must
+                # carry the full -s/-l frame (a truncated shard would
+                # silently shrink the searched keyspace)
+                for m in work.get("masks") or []:
+                    missing = {"mask", "skip", "limit"} - set(m)
+                    if missing:
+                        raise ValueError(
+                            f"malformed mask shard: missing "
+                            f"{sorted(missing)}")
             except ValueError as e:
                 # Truncated/garbage body: re-fetch a bounded number of
                 # times (a proxy can mangle one response), then classify

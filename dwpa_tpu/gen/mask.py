@@ -55,18 +55,37 @@ def mask_keyspace(mask: str, custom: dict = None) -> int:
 
 
 def mask_words(mask: str, custom: dict = None, skip: int = 0, limit: int = None):
-    """Yield mask words; ``skip``/``limit`` slice the keyspace for resume."""
+    """Yield mask words; ``skip``/``limit`` slice the keyspace for resume.
+
+    Odometer enumeration: the digit vector is seeded once from ``skip``
+    (the only arbitrary-precision divmod walk), then each word is the
+    previous one with a last-position-fastest increment — O(1) amortized
+    carries per word instead of a full per-index divmod chain, which
+    keeps the host parity-oracle legs in tests (and the no-device
+    fallback) off the slow path.
+    """
     alphas = parse_mask(mask, custom)
     total = mask_keyspace(mask, custom)
     end = total if limit is None else min(total, skip + limit)
+    if skip >= end:
+        return
     sizes = [len(a) for a in alphas]
-    for idx in range(skip, end):
-        word = bytearray(len(alphas))
-        rem = idx
-        for p in range(len(alphas) - 1, -1, -1):
-            rem, d = divmod(rem, sizes[p])
-            word[p] = alphas[p][d]
+    digits = mask_digits_at(mask, skip, custom)
+    word = bytearray(alphas[p][digits[p]] for p in range(len(alphas)))
+    last = len(alphas) - 1
+    for _ in range(end - skip - 1):
         yield bytes(word)
+        p = last
+        while True:  # increment with carry, last position fastest
+            d = digits[p] + 1
+            if d < sizes[p]:
+                digits[p] = d
+                word[p] = alphas[p][d]
+                break
+            digits[p] = 0
+            word[p] = alphas[p][0]
+            p -= 1
+    yield bytes(word)
 
 
 class MaskPrep:

@@ -15,6 +15,7 @@ point covers them:
 
 import argparse
 import json
+import re
 import sys
 import time
 
@@ -359,6 +360,32 @@ def cmd_enrich(args):
         enrich_message_pair(_core(args), limit=args.limit, extractor=ex)))
 
 
+def cmd_ks_add(args):
+    from ..keyspace import KeyspaceError
+
+    try:
+        row = _core(args).ks_add(args.ssid_re, args.pass_re,
+                                 priority=args.priority,
+                                 enabled=not args.disabled)
+    except KeyspaceError as e:
+        # Loud rejection is the dialect's contract: a pattern the
+        # compiler can't cover exactly must never be half-scheduled.
+        raise SystemExit(f"pass-regex rejected: {e}")
+    except re.error as e:
+        raise SystemExit(f"bad --ssid-re: {e}")
+    print(json.dumps(row))
+
+
+def cmd_ks_list(args):
+    core = _core(args)
+    out = []
+    for row in core.ks_rows(enabled_only=False):
+        d = dict(row)
+        d["keyspace"] = core._ks_cache.keyspace(row["pass_regex"])
+        out.append(d)
+    print(json.dumps(out))
+
+
 def cmd_reorder_captures(args):
     from .tools import reorder_captures
 
@@ -528,6 +555,30 @@ def main(argv=None):
     sp.add_argument("--native", action="store_true",
                     help="use the C++ bulk parser (native/capture_fast)")
     sp.set_defaults(fn=cmd_enrich)
+
+    sp = sub.add_parser("ks-add",
+                        help="add a smart-keyspace rule: nets whose SSID "
+                             "matches --ssid-re get mask shards compiled "
+                             "from --pass-re scheduled alongside dicts")
+    common(sp)
+    sp.add_argument("--ssid-re", required=True,
+                    help="SSID filter (re.search semantics; anchor with "
+                         "^...$ for an exact match)")
+    sp.add_argument("--pass-re", required=True,
+                    help="password pattern in the bounded dialect "
+                         "(literals, [...], \\d, {n}/{m,n}/?, top-level "
+                         "|); anything else is rejected loudly")
+    sp.add_argument("--priority", type=int, default=0,
+                    help="higher priorities are planned first")
+    sp.add_argument("--disabled", action="store_true",
+                    help="insert the rule disabled (enable later in SQL)")
+    sp.set_defaults(fn=cmd_ks_add)
+
+    sp = sub.add_parser("ks-list",
+                        help="list smart-keyspace rules with compiled "
+                             "keyspace sizes")
+    common(sp)
+    sp.set_defaults(fn=cmd_ks_list)
 
     sp = sub.add_parser("reorder-captures",
                         help="migrate a flat capture archive to the dated "

@@ -34,6 +34,8 @@ import sqlite3
 import threading
 import time
 
+from ..keyspace import MaskCache, compile_pass_regex
+from ..keyspace.schedule import ks_matches, mask_keyspace_totals, next_uncovered
 from ..models import hashline as hl
 from ..oracle import m22000 as oracle
 from ..utils.fsio import fsync_replace
@@ -45,6 +47,7 @@ MAX_DICTCOUNT = 15          # dictcount clamp (get_work.php:41-46)
 LEASE_REAP_S = 3 * 3600     # stale work-unit reclaim (maint.php:36)
 SERVER_NC = 128             # server-side NC search width (common.php:157)
 MAX_INFLIGHT = 4096         # default bound on live work-unit leases
+MASK_SHARD_SPAN = 2_000_000  # candidates per mask shard (~8 s/chip @264k/s)
 OVERLOAD_RETRY_AFTER_S = 2  # Retry-After hint handed to shed clients
 LEASE_RETENTION_S = 7 * 86400  # released/reaped lease rows kept this long
 
@@ -248,6 +251,12 @@ class ServerCore:
         # analog is the PHP upload limit — deployment-tunable, so this
         # is too (serve --capture-cap).
         self.capture_cap = capture_cap
+        # Smart keyspace (ROADMAP 4): compiled-mask cache keyed by
+        # pass_regex (compilation is pure, so one cache serves every
+        # request thread) and the per-shard candidate budget — each
+        # mask shard occupies one dictcount slot in a work unit.
+        self._ks_cache = MaskCache()
+        self.mask_shard_span = MASK_SHARD_SPAN
         self.hcdir = hcdir            # client-distribution dir (web/hc/)
         self.mailer = mailer          # mail.Mailer or None (delivery skipped)
         self.bosskey = bosskey        # 32-hex superuser key (conf.php)
@@ -437,6 +446,34 @@ class ServerCore:
         return cur.lastrowid
 
     # ------------------------------------------------------------------
+    # Smart keyspace: the ks table
+    # ------------------------------------------------------------------
+
+    def ks_add(self, ssid_regex: str, pass_regex: str, priority: int = 0,
+               enabled: bool = True) -> int:
+        """Register an ssid-regex -> pass-regex keyspace row.
+
+        Validation is loud and up-front: a broken ssid_regex raises
+        ``re.error`` and an uncompilable pass_regex raises
+        :class:`..keyspace.KeyspaceError` — a row never lands in ks
+        unless the scheduler can actually turn it into mask shards.
+        """
+        re.compile(ssid_regex)
+        compile_pass_regex(pass_regex)
+        cur = self.db.x(
+            "INSERT INTO ks(ssid_regex, pass_regex, priority, enabled) "
+            "VALUES (?, ?, ?, ?)",
+            (ssid_regex, pass_regex, int(priority), 1 if enabled else 0),
+        )
+        return cur.lastrowid
+
+    def ks_rows(self, enabled_only: bool = True):
+        """ks rows in scheduling order (priority DESC, then insertion)."""
+        where = "WHERE enabled = 1 " if enabled_only else ""
+        return self.db.q(
+            f"SELECT * FROM ks {where}ORDER BY priority DESC, ks_id")
+
+    # ------------------------------------------------------------------
     # The scheduler: get_work
     # ------------------------------------------------------------------
 
@@ -516,38 +553,94 @@ class ServerCore:
             return 0
         if len(self.queue) > 0:
             return 0  # refill only from empty: stale entries age out fast
+        batch = limit or self.queue_batch
         rows = self.db.q(
             """SELECT net_id FROM nets
                WHERE n_state = 0 AND algo = ''
                  AND hits < (SELECT COUNT(*) FROM dicts)
                ORDER BY hits, ts LIMIT ?""",
-            (limit or self.queue_batch,),
+            (batch,),
         )
-        self.queue.push_many([r["net_id"] for r in rows])
-        return len(rows)
+        ids = [r["net_id"] for r in rows]
+        if len(ids) < batch:
+            # dict-exhausted nets stay issuable while a matching ks row
+            # has uncovered mask keyspace (entries are hints — the
+            # pop-side revalidation and _plan_mask_shards' coverage walk
+            # keep staleness from double-issuing)
+            ids += self._mask_eligible(batch - len(ids), exclude=ids)
+        self.queue.push_many(ids)
+        return len(ids)
+
+    def _mask_eligible(self, limit: int, exclude=()) -> list:
+        """net_ids whose dicts are exhausted but whose matching ks rows
+        still have uncovered mask keyspace, scheduler order."""
+        ks = self.db.q("SELECT * FROM ks WHERE enabled = 1 "
+                       "ORDER BY priority DESC, ks_id")
+        if not ks:
+            return []
+        out, skip = [], set(exclude)
+        for r in self.db.q(
+            """SELECT net_id, ssid FROM nets
+               WHERE n_state = 0 AND algo = ''
+                 AND hits >= (SELECT COUNT(*) FROM dicts)
+               ORDER BY hits, ts"""
+        ):
+            if len(out) >= limit:
+                break
+            if r["net_id"] in skip:
+                continue
+            total = sum(self._ks_cache.keyspace(k["pass_regex"])
+                        for k in ks_matches(ks, r["ssid"]))
+            if total == 0:
+                continue
+            covered = self.db.q1(
+                "SELECT COALESCE(SUM(span), 0) c FROM n2m WHERE net_id = ?",
+                (r["net_id"],))["c"]
+            if covered < total:
+                out.append(r["net_id"])
+        return out
 
     def _lease_unit(self, target, dictcount: int) -> dict:
         """Issue one epoch-leased unit for ``target``, or None when the
-        target has no untried dicts left (caller moves to the next
-        target).  Runs inside the caller's transaction (tx() nests)."""
+        target has neither untried dicts nor uncovered mask keyspace
+        left (caller moves to the next target).  Runs inside the
+        caller's transaction (tx() nests).
+
+        Dict shards fill first (smallest wordlists, the reference's
+        ``ORDER BY wcount``); leftover dictcount slots carry mask
+        shards from matching ks rows — up to a pure-mask unit with
+        ``dicts: []`` when every dictionary is already covered.
+        """
         dicts = self.db.q(
             """SELECT * FROM dicts WHERE d_id NOT IN
                  (SELECT d_id FROM n2d WHERE net_id = ?)
                ORDER BY wcount, dname LIMIT ?""",
             (target["net_id"], dictcount),
         )
-        if not dicts:
+        mask_entries, mask_rows = self._plan_mask_shards(
+            target["net_id"], target["ssid"], dictcount - len(dicts))
+        if not dicts and not mask_entries:
             return None
         d_ids = [d["d_id"] for d in dicts]
-        ph = ",".join("?" * len(d_ids))
-        # every uncracked net sharing the SSID, not yet covered by these dicts
-        nets = self.db.q(
-            f"""SELECT net_id, struct FROM nets
-                WHERE ssid = ? AND n_state = 0 AND algo = ''
-                  AND net_id NOT IN
-                    (SELECT net_id FROM n2d WHERE d_id IN ({ph}))""",
-            (target["ssid"], *d_ids),
-        )
+        if d_ids:
+            ph = ",".join("?" * len(d_ids))
+            # every uncracked net sharing the SSID, not yet covered by
+            # these dicts
+            nets = self.db.q(
+                f"""SELECT net_id, struct FROM nets
+                    WHERE ssid = ? AND n_state = 0 AND algo = ''
+                      AND net_id NOT IN
+                        (SELECT net_id FROM n2d WHERE d_id IN ({ph}))""",
+                (target["ssid"], *d_ids),
+            )
+        else:
+            # pure-mask unit: the whole uncracked SSID group rides along
+            # (INSERT OR IGNORE leaves already-covered shards untouched)
+            nets = self.db.q(
+                """SELECT net_id, struct FROM nets
+                   WHERE ssid = ? AND n_state = 0 AND algo = ''""",
+                (target["ssid"],),
+            )
         if not nets:
             return None
         hkey = gen_key()
@@ -564,6 +657,13 @@ class ServerCore:
                         "INSERT OR IGNORE INTO n2d(net_id, d_id, hkey, epoch) "
                         "VALUES (?,?,?,?)",
                         (n["net_id"], d, hkey, epoch),
+                    )
+                for ks_id, mask_i, skip, span in mask_rows:
+                    self.db.x(
+                        "INSERT OR IGNORE INTO "
+                        "n2m(net_id, ks_id, mask_i, skip, span, hkey, epoch) "
+                        "VALUES (?,?,?,?,?,?,?)",
+                        (n["net_id"], ks_id, mask_i, skip, span, hkey, epoch),
                     )
         if self.queue is not None:
             self.queue.discard(n["net_id"] for n in nets)
@@ -582,9 +682,54 @@ class ServerCore:
         }
         if merged:
             work["rules"] = base64.b64encode("\n".join(merged).encode()).decode()
+        if mask_entries:
+            work["masks"] = mask_entries
         if self._prdict_available(hkey):
             work["prdict"] = True
         return work
+
+    def _plan_mask_shards(self, net_id: int, ssid: bytes, budget: int):
+        """Pick up to ``budget`` uncovered mask shards for ``net_id``.
+
+        Returns ``(entries, rows)``: wire entries
+        ``{mask, custom, skip, limit}`` for the work unit, and matching
+        ``(ks_id, mask_i, skip, span)`` tuples for the n2m lease
+        inserts.  ks rows are tried best-priority first; within a row,
+        masks smallest-keyspace first (the compiler pre-sorts — the
+        mask analog of ``ORDER BY wcount``).  Every skip/limit comes
+        from first-gap coverage walks bounded by the compiled
+        ``mask_keyspace`` (reaped ranges reappear as gaps and are
+        re-issued); runs inside the caller's scheduler lock.
+        """
+        entries, rows = [], []
+        if budget <= 0:
+            return entries, rows
+        ks = self.db.q("SELECT * FROM ks WHERE enabled = 1 "
+                       "ORDER BY priority DESC, ks_id")
+        for k in ks_matches(ks, ssid):
+            ck = self._ks_cache.get(k["pass_regex"])
+            if ck is None:
+                continue
+            for mask_i, m in enumerate(ck.masks):
+                cov = self.db.q(
+                    "SELECT skip, span FROM n2m "
+                    "WHERE net_id = ? AND ks_id = ? AND mask_i = ?",
+                    (net_id, k["ks_id"], mask_i),
+                )
+                taken = []
+                while len(entries) < budget:
+                    shard = next_uncovered(cov, m.keyspace,
+                                           self.mask_shard_span, taken)
+                    if shard is None:
+                        break
+                    skip, span = shard
+                    taken.append((skip, span))
+                    entries.append({"mask": m.mask, "custom": dict(m.custom),
+                                    "skip": skip, "limit": span})
+                    rows.append((k["ks_id"], mask_i, skip, span))
+                if len(entries) >= budget:
+                    return entries, rows
+        return entries, rows
 
     def _prdict_available(self, hkey: str) -> bool:
         """PROBEREQUEST dict availability for a work unit: prs rows joined
@@ -702,6 +847,14 @@ class ServerCore:
                     "UPDATE n2d SET hkey = NULL WHERE hkey = ? AND epoch = ?",
                     (hkey, epoch),
                 )
+                # mask shards release identically: hkey NULL = range done.
+                # A reaped unit's n2m rows were DELETEd, so the stale
+                # holder's keyed release above matched no lease and never
+                # reaches here — a re-issued range cannot double-credit.
+                self.db.x(
+                    "UPDATE n2m SET hkey = NULL WHERE hkey = ? AND epoch = ?",
+                    (hkey, epoch),
+                )
             return cur.rowcount
 
     def _nets_for_claim(self, ctype: str, key: str):
@@ -778,6 +931,7 @@ class ServerCore:
                     (psk, pmk, nc, endian, now(), net_id),
                 )
                 self.db.x("DELETE FROM n2d WHERE net_id = ?", (net_id,))
+                self.db.x("DELETE FROM n2m WHERE net_id = ?", (net_id,))
 
     def _delete_net(self, net_id: int):
         with self._getwork_lock:
@@ -832,6 +986,14 @@ class ServerCore:
                       "nets by crack state").labels(state=label).set(
                 self.db.q1("SELECT COUNT(*) c FROM nets WHERE n_state = ?",
                            (state,))["c"])
+        mask_total, mask_done = mask_keyspace_totals(self.db, self._ks_cache)
+        reg.gauge("dwpa_keyspace_mask_total",
+                  "scheduled mask keyspace over uncracked nets "
+                  "(candidates, summed per matching ks row)"
+                  ).set(mask_total)
+        reg.gauge("dwpa_keyspace_mask_done",
+                  "completed mask-shard coverage (released n2m spans, "
+                  "candidates)").set(mask_done)
 
     # ------------------------------------------------------------------
     # Users & potfile export

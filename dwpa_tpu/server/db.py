@@ -11,6 +11,12 @@ translation:
   writers stay consistent;
 - the nets.hash / submissions.hash uniqueness + INSERT OR IGNORE give the
   same idempotent-ingestion semantics;
+- two tables have no reference twin: ``leases`` (epoch-numbered work-unit
+  leases, the crash-safety spine) and ``n2m`` (net x compiled-mask
+  shard-range coverage for the smart-keyspace vertical — ``n2d``'s analog
+  for the ks table, same hkey/epoch lease discipline; a reaped range is
+  DELETEd, never NULLed, because a NULL hkey row MEANS completed
+  coverage);
 - WAL journal + a statement-level lock on the shared connection make the
   handle thread-safe under the threaded server; the larger critical
   section the reference guards with its SHM lockfile (work-unit issue)
@@ -149,10 +155,36 @@ CREATE TABLE IF NOT EXISTS p2s (
     PRIMARY KEY (p_id, s_id)
 );
 
+-- Smart keyspace (the reference's dormant ks table, wired for real):
+-- ssid_regex selects nets, pass_regex compiles to mask shards
+-- (keyspace/compiler.py).  priority orders competing rows; enabled=0
+-- parks a row without losing its n2m coverage history.
 CREATE TABLE IF NOT EXISTS ks (
+    ks_id      INTEGER PRIMARY KEY,
     ssid_regex TEXT NOT NULL,
-    pass_regex TEXT NOT NULL
+    pass_regex TEXT NOT NULL,
+    priority   INTEGER NOT NULL DEFAULT 0,
+    enabled    INTEGER NOT NULL DEFAULT 1
 );
+
+-- Mask-shard coverage, mirroring n2d: one row per net x compiled mask x
+-- keyspace range.  span counts candidates from offset skip (hashcat
+-- -s/-l framing); hkey/epoch carry the same lease semantics as n2d
+-- (non-NULL hkey = in flight; release NULLs it = done).  Reap DELETEs
+-- stale rows so abandoned ranges reappear as coverage gaps and are
+-- re-issued under a fresh epoch.
+CREATE TABLE IF NOT EXISTS n2m (
+    net_id INTEGER NOT NULL REFERENCES nets(net_id) ON DELETE CASCADE,
+    ks_id  INTEGER NOT NULL REFERENCES ks(ks_id),
+    mask_i INTEGER NOT NULL,    -- index into the compiled pass_regex masks
+    skip   INTEGER NOT NULL,    -- keyspace offset of this shard
+    span   INTEGER NOT NULL,    -- candidate count (the wire "limit")
+    hkey   TEXT,
+    epoch  INTEGER NOT NULL DEFAULT 0,
+    ts     REAL NOT NULL DEFAULT (strftime('%s','now')),
+    PRIMARY KEY (net_id, ks_id, mask_i, skip)
+);
+CREATE INDEX IF NOT EXISTS idx_n2m_hkey ON n2m(hkey);
 
 CREATE TABLE IF NOT EXISTS stats (
     name  TEXT PRIMARY KEY,
@@ -201,6 +233,17 @@ class Database:
         if "epoch" not in cols:
             self.conn.execute(
                 "ALTER TABLE n2d ADD COLUMN epoch INTEGER NOT NULL DEFAULT 0")
+        # Legacy ks tables predate ks_id/priority/enabled; ALTER cannot
+        # add a PRIMARY KEY column, so rebuild in place (rename, recreate
+        # from SCHEMA, copy, drop).
+        cols = [r[1] for r in self.conn.execute("PRAGMA table_info(ks)")]
+        if cols and "ks_id" not in cols:
+            self.conn.execute("ALTER TABLE ks RENAME TO ks_legacy")
+            self.conn.executescript(SCHEMA)
+            self.conn.execute(
+                "INSERT INTO ks(ssid_regex, pass_regex) "
+                "SELECT ssid_regex, pass_regex FROM ks_legacy")
+            self.conn.execute("DROP TABLE ks_legacy")
         self.conn.executemany(
             "INSERT OR IGNORE INTO stats(name, value) VALUES (?, 0)",
             [(n,) for n in STAT_NAMES],

@@ -28,6 +28,7 @@ import time
 from ..gen.dicts import md5_file
 from ..gen.psktool import psk_candidates
 from ..gen.vendors import vendor_candidates
+from ..keyspace.schedule import mask_keyspace_totals
 from ..models import hashline as hl
 from ..obs import get_logger
 from ..oracle import m22000 as oracle
@@ -87,18 +88,28 @@ def _maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
     s["24getwork"] = db.q1(
         "SELECT COUNT(DISTINCT hkey) c FROM n2d WHERE ts > ?", (day_ago,)
     )["c"]
-    # 24 h keyspace throughput: sum of dict wordcounts over last-day leases
+    # 24 h keyspace throughput: sum of dict wordcounts over last-day
+    # leases, plus last-day mask-shard spans (the shard IS its count)
     s["24psk"] = db.q1(
         """SELECT COALESCE(SUM(d.wcount), 0) c FROM n2d
            JOIN dicts d ON d.d_id = n2d.d_id WHERE n2d.ts > ?""",
         (day_ago,),
+    )["c"] + db.q1(
+        "SELECT COALESCE(SUM(span), 0) c FROM n2m WHERE ts > ?", (day_ago,)
     )["c"]
+    # round totals: dict words × uncracked nets plus the scheduled mask
+    # keyspace of every matching enabled ks row (smart keyspace — the
+    # dicts-only total undercounted the round once mask shards existed)
     total_words = db.q1("SELECT COALESCE(SUM(wcount), 0) c FROM dicts")["c"]
-    s["words"] = s["uncracked"] * total_words
+    mask_total, _ = mask_keyspace_totals(db, core._ks_cache)
+    s["words"] = s["uncracked"] * total_words + mask_total
     s["triedwords"] = db.q1(
         """SELECT COALESCE(SUM(d.wcount), 0) c FROM n2d
            JOIN dicts d ON d.d_id = n2d.d_id
            JOIN nets n ON n.net_id = n2d.net_id WHERE n.n_state = 0"""
+    )["c"] + db.q1(
+        """SELECT COALESCE(SUM(m.span), 0) c FROM n2m m
+           JOIN nets n ON n.net_id = m.net_id WHERE n.n_state = 0"""
     )["c"]
     s["contributors"] = db.q1(
         "SELECT COUNT(DISTINCT hkey) c FROM n2d WHERE hkey IS NOT NULL"
@@ -120,6 +131,19 @@ def _maintenance(core: ServerCore, cracked_dict_path: str = None) -> dict:
         with db.tx():
             reaped = db.x(
                 """UPDATE n2d SET hkey = NULL
+                   WHERE hkey IS NOT NULL
+                     AND (ts < ? OR hkey IN (SELECT hkey FROM leases
+                                             WHERE state = 0 AND issued < ?))""",
+                (cutoff, cutoff),
+            ).rowcount
+            # Mask shards are DELETEd, not NULLed: a NULLed n2m row would
+            # count as completed coverage, but an abandoned range was
+            # never searched — dropping the row reopens the gap so
+            # _plan_mask_shards re-issues it under a fresh epoch, while
+            # the lease flip below still blocks the stale holder's
+            # release (no double-credit).
+            reaped += db.x(
+                """DELETE FROM n2m
                    WHERE hkey IS NOT NULL
                      AND (ts < ? OR hkey IN (SELECT hkey FROM leases
                                              WHERE state = 0 AND issued < ?))""",

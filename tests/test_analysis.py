@@ -848,6 +848,91 @@ def test_dw113_real_stream_and_feed_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# DW116: framed-mask dispatch seam
+# ---------------------------------------------------------------------------
+
+
+def test_dw116_flags_raw_enumerator_on_dispatch_path():
+    """The seeded failure mode: the client crack loop 'helpfully'
+    enumerating a mask shard host-side — re-deriving the framing by
+    hand and shipping candidate bytes the device generator exists to
+    absorb."""
+    src = """
+        from ..gen.mask import mask_words
+
+        def _run_shard(self, shard):
+            for w in mask_words(shard["mask"], skip=shard["skip"]):
+                self._feed(w)
+    """
+    vs = lint(src, "dwpa_tpu/client/main.py")
+    assert codes(vs) == ["DW116", "DW116"]
+    assert "mask_blocks" in vs[0].detail
+    assert "_prepare_block" in vs[1].detail
+    # the engine's device-generation seam and the low-volume targeted
+    # host generators are outside the scope by design
+    assert lint(src, "dwpa_tpu/models/m22000.py") == []
+    assert lint(src, "dwpa_tpu/client/targeted.py") == []
+
+
+def test_dw116_flags_hand_built_maskprep_in_streams():
+    """A hand-built MaskPrep carries whatever start offset the caller
+    typed — off mask_blocks' keyspace-bounded framing, resume offsets
+    drift off hashcat -s coordinates."""
+    src = """
+        from ..gen.mask import MaskPrep
+
+        def _requeue(self, block):
+            return MaskPrep(block.prep.mask, block.prep.custom, 0)
+    """
+    vs = lint(src, "dwpa_tpu/parallel/streams.py")
+    assert codes(vs) == ["DW116", "DW116"]
+    assert "hashcat -s" in vs[1].detail
+
+
+def test_dw116_flags_device_enumerator_in_feed_and_sched():
+    src = """
+        def _produce_mask(self, mask, start, batch):
+            from ..gen.mask import device_mask_words
+            return device_mask_words(mask, start, batch)
+    """
+    for path in ("dwpa_tpu/feed/pipeline.py", "dwpa_tpu/sched/fuse.py",
+                 "dwpa_tpu/keyspace/schedule.py"):
+        vs = lint(src, path)
+        assert codes(vs) == ["DW116", "DW116"], path
+
+
+def test_dw116_mask_blocks_is_the_sanctioned_carrier():
+    """The compliant idiom: frame the shard through mask_blocks and hand
+    the framed blocks to the engine — exactly what the client's mask
+    pass does."""
+    assert lint("""
+        from ..gen.mask import mask_blocks
+
+        def _run_shard(self, engine, shard):
+            blocks = mask_blocks(shard["mask"], 4096, skip=shard["skip"],
+                                 limit=shard["limit"])
+            self._crack_blocks(engine, blocks, on_batch=None)
+    """, "dwpa_tpu/client/main.py") == []
+
+
+def test_dw116_real_dispatch_tree_is_clean():
+    """The shipped mask path obeys its own seam: streams, feed, the
+    client crack loop and the scheduling layers never enumerate raw."""
+    from dwpa_tpu.analysis.linter import lint_file
+
+    root = repo_root()
+    targets = [os.path.join(root, "dwpa_tpu", "parallel", "streams.py"),
+               os.path.join(root, "dwpa_tpu", "client", "main.py")]
+    for sub in (("feed",), ("sched",), ("keyspace",)):
+        d = os.path.join(root, "dwpa_tpu", *sub)
+        targets += [os.path.join(d, n) for n in sorted(os.listdir(d))
+                    if n.endswith(".py")]
+    for path in targets:
+        assert [v for v in lint_file(path, root)
+                if v.code == "DW116"] == [], path
+
+
+# ---------------------------------------------------------------------------
 # DW109: fused-pad-width discipline
 # ---------------------------------------------------------------------------
 
@@ -1414,8 +1499,8 @@ def test_full_tree_clean_under_checked_in_baseline():
 def test_full_tree_violations_all_known_codes():
     known = {"DW101", "DW102", "DW103", "DW104", "DW105", "DW106", "DW107",
              "DW108", "DW109", "DW111", "DW112", "DW113", "DW114", "DW115",
-             "DW201", "DW202", "DW203", "DW204", "DW301", "DW302", "DW303",
-             "DW304"}
+             "DW116", "DW201", "DW202", "DW203", "DW204", "DW301", "DW302",
+             "DW303", "DW304"}
     vs = collect_violations(repo_root())
     assert vs, "the baseline documents accepted syncs; none found?"
     assert {v.code for v in vs} <= known

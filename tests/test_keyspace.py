@@ -1,0 +1,239 @@
+"""Smart-keyspace compiler + scheduler-helper unit tests.
+
+Compiler property: every word a compiled mask enumerates fullmatches
+the source pass-regex, and the compiled keyspace counts the language
+EXACTLY — the loud-rejection contract's other half (what does compile
+is bit-exact; what cannot be exact raises ``KeyspaceError``).
+Plus the host odometer's parity against a per-index divmod oracle —
+the generator every mask-resume proof in this repo leans on.
+"""
+
+import random
+import re
+
+import pytest
+
+from dwpa_tpu.gen.mask import (mask_blocks, mask_digits_at, mask_keyspace,
+                               mask_words, parse_mask)
+from dwpa_tpu.keyspace import (CompiledKeyspace, KeyspaceError, MaskCache,
+                               compile_pass_regex, ks_matches,
+                               next_uncovered)
+
+
+def _language(ck):
+    """Every word every compiled mask enumerates (latin1 text)."""
+    out = []
+    for m in ck.masks:
+        out += [w.decode("latin1")
+                for w in mask_words(m.mask, m.custom_bytes())]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# compiler: exactness properties
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pattern", [
+    r"^wifipass\d{2}$",
+    r"TALK[0-9]{2}\d{2}",
+    r"^[a-c]{1,3}X$",
+    r"net\d{3}|wifi[xy]z",
+    r"ab?c?d",
+    r"\?\d{2}\\",          # escaped metacharacters as literals
+    r"[0-9][a-z][A-F0-9]",  # builtin charsets by content
+    r"pw[_\-.]\d",
+])
+def test_compiled_language_is_exact(pattern):
+    ck = compile_pass_regex(pattern)
+    words = _language(ck)
+    # exact count: the summed mask keyspace IS the enumeration length
+    assert len(words) == ck.keyspace
+    # soundness: every enumerated word matches the source regex
+    for w in words:
+        assert re.fullmatch(pattern, w), (pattern, w)
+    # masks don't overlap for these disjoint-branch patterns
+    assert len(set(words)) == len(words)
+
+
+def test_optional_atoms_expand_per_length():
+    """``?`` = {0,1}: each length choice becomes its own mask, counts
+    summing to the product of (1 + |alpha|) per optional atom."""
+    ck = compile_pass_regex(r"a[bc]?[de]?")
+    assert ck.keyspace == 1 + 2 + 2 + 4
+    lengths = sorted(len(m.mask.replace("?1", "x").replace("?2", "x"))
+                     for m in ck.masks)
+    assert len(ck.masks) == 4
+    words = _language(ck)
+    assert sorted(words) == sorted(
+        {w for w in ("a", "ab", "ac", "ad", "ae", "abd", "abe", "acd",
+                     "ace")})
+    assert lengths == sorted(lengths)
+
+
+def test_masks_sorted_smallest_keyspace_first():
+    """The compiler pre-sorts masks so mask_i ordering (and the
+    scheduler's smallest-first issue order) is deterministic."""
+    ck = compile_pass_regex(r"\d{4}|[ab]x|net[0-9a-f]{2}")
+    sizes = [m.keyspace for m in ck.masks]
+    assert sizes == sorted(sizes)
+    assert sizes[0] == 2          # [ab]x
+    assert sizes[-1] == 10000     # \d{4}
+
+
+def test_builtin_charsets_recognized_by_content():
+    ck = compile_pass_regex(r"[0-9][a-z][A-Z][0-9a-f]")
+    assert [m.mask for m in ck.masks] == ["?d?l?u?h"]
+    assert ck.masks[0].custom == {}
+
+
+def test_custom_charsets_allocated_and_shared():
+    ck = compile_pass_regex(r"[abc][xy][abc]")
+    (m,) = ck.masks
+    assert m.mask == "?1?2?1"     # repeated class reuses its slot
+    assert m.custom == {"1": "abc", "2": "xy"}
+    assert m.keyspace == 3 * 2 * 3
+    # the bytes view parses through gen.mask with the same count
+    assert mask_keyspace(m.mask, m.custom_bytes()) == m.keyspace
+
+
+def test_literal_question_mark_escaped_for_hashcat():
+    ck = compile_pass_regex(r"a\?b")
+    (m,) = ck.masks
+    assert m.mask == "a??b"
+    assert [w for w in mask_words(m.mask, m.custom_bytes())] == [b"a?b"]
+
+
+@pytest.mark.parametrize("pattern,reason_part", [
+    (r"free.*", "'.'"),
+    (r"a*", "unbounded"),
+    (r"a+", "unbounded"),
+    (r"(ab)c", "groups"),
+    (r"(?=x)y", "groups"),
+    (r"[^abc]", "negated"),
+    (r"[b-a]", "reversed range"),
+    (r"[]", "empty character class"),
+    (r"[abc", "unterminated"),
+    (r"a{2,1}", "reversed quantifier"),
+    (r"a{", "unterminated"),
+    (r"a{x}", "malformed"),
+    (r"{3}", "without a free atom"),
+    (r"a{2}?", "without a free atom"),   # stacked/lazy quantifier
+    (r"?a", "without a free atom"),
+    (r"a\w", "unsupported escape"),
+    (r"a^b", "mid-pattern anchor"),
+    (r"", "empty pattern"),
+    (r"a|", "empty alternation branch"),
+    (r"x?", "matches the empty string"),
+    (r"\d{64}", "longer than 63"),
+    (r"a?b?c?d?e?f?g?h?i?", "more than 64 masks"),
+    ("p€ssword", "non-latin1"),
+    (r"[ab][cd][ef][gh][ij]", "more than 4 custom charsets"),
+])
+def test_loud_rejection_never_silent_truncation(pattern, reason_part):
+    with pytest.raises(KeyspaceError) as ei:
+        compile_pass_regex(pattern)
+    assert reason_part in ei.value.reason
+    assert ei.value.pattern == pattern
+
+
+def test_edge_anchors_accepted_and_dropped():
+    for pat in (r"^ab$", r"ab", r"^ab", r"ab$"):
+        ck = compile_pass_regex(pat)
+        assert [m.mask for m in ck.masks] == ["ab"]
+        assert ck.keyspace == 1
+
+
+def test_alternation_split_respects_escapes_and_classes():
+    ck = compile_pass_regex(r"a\|b|[x|y]")
+    words = set(_language(ck))
+    assert words == {"a|b", "x", "|", "y"}
+
+
+# ---------------------------------------------------------------------------
+# host odometer vs per-index divmod oracle
+# ---------------------------------------------------------------------------
+
+
+def _oracle_words(mask, custom, skip, limit):
+    alphas = parse_mask(mask, custom)
+    total = mask_keyspace(mask, custom)
+    end = total if limit is None else min(total, skip + limit)
+    out = []
+    for idx in range(skip, end):
+        digits = mask_digits_at(mask, idx, custom)
+        out.append(bytes(alphas[p][digits[p]] for p in range(len(alphas))))
+    return out
+
+
+@pytest.mark.parametrize("mask,custom", [
+    ("?d?d?d", None),
+    ("a?l?d", None),
+    ("?1?2?1", {"1": b"abc", "2": b"XY"}),
+    ("x", None),
+    ("", None),
+])
+def test_odometer_matches_divmod_oracle(mask, custom):
+    rng = random.Random(1234)
+    total = mask_keyspace(mask, custom)
+    slices = [(0, None), (0, 1), (total, 5), (max(0, total - 1), None)]
+    slices += [(rng.randrange(total + 1), rng.randrange(1, total + 2))
+               for _ in range(8)]
+    for skip, limit in slices:
+        got = list(mask_words(mask, custom, skip=skip, limit=limit))
+        assert got == _oracle_words(mask, custom, skip, limit), (skip, limit)
+
+
+def test_mask_blocks_offsets_are_absolute_keyspace_indices():
+    blocks = list(mask_blocks("?d?d?d", 128, skip=100, limit=300))
+    assert [(b.offset, b.count) for b in blocks] == [
+        (100, 128), (228, 128), (356, 44)]
+    for b in blocks:
+        assert b.words == [] and b.prep.mask_gen
+        assert b.prep.start == b.offset
+
+
+# ---------------------------------------------------------------------------
+# scheduler helpers
+# ---------------------------------------------------------------------------
+
+
+def test_next_uncovered_walks_first_gap():
+    ks = 100
+    assert next_uncovered([], ks, 40) == (0, 40)
+    cov = [{"skip": 0, "span": 40}]
+    assert next_uncovered(cov, ks, 40) == (40, 40)
+    # a reaped (DELETEd) middle range reappears as the first gap
+    cov = [{"skip": 0, "span": 20}, {"skip": 60, "span": 40}]
+    assert next_uncovered(cov, ks, 40) == (20, 40)
+    # the gap bounds the issue even below span
+    cov = [{"skip": 0, "span": 20}, {"skip": 30, "span": 70}]
+    assert next_uncovered(cov, ks, 40) == (20, 10)
+    # locally planned (not yet inserted) ranges count via ``extra``
+    assert next_uncovered([], ks, 40, extra=[(0, 40), (40, 40)]) == (80, 20)
+    cov = [{"skip": 0, "span": 100}]
+    assert next_uncovered(cov, ks, 40) is None
+
+
+def test_ks_matches_search_semantics_and_broken_rows():
+    rows = [{"ssid_regex": r"^HOME-", "pass_regex": "x"},
+            {"ssid_regex": r"NET", "pass_regex": "y"},
+            {"ssid_regex": r"([", "pass_regex": "z"}]  # broken: skipped
+    assert [r["pass_regex"] for r in ks_matches(rows, b"HOME-1234")] == ["x"]
+    assert [r["pass_regex"] for r in ks_matches(rows, b"MYNETWORK")] == ["y"]
+    assert ks_matches(rows, b"other") == []
+    # latin1 ssid bytes decode, never raise
+    assert ks_matches(rows, bytes(range(200, 210))) == []
+
+
+def test_mask_cache_compiles_once_and_caches_misses():
+    cache = MaskCache()
+    ck = cache.get(r"^pw\d{2}$")
+    assert isinstance(ck, CompiledKeyspace) and cache.compiles == 1
+    assert cache.get(r"^pw\d{2}$") is ck     # warm: no recompile
+    assert cache.compiles == 1
+    assert cache.keyspace(r"^pw\d{2}$") == 100
+    assert cache.get(r"bad(") is None        # uncompilable: cached miss
+    assert cache.get(r"bad(") is None
+    assert cache.keyspace(r"bad(") == 0
+    assert cache.compiles == 1

@@ -1,0 +1,306 @@
+"""Smart-keyspace scheduling + loopback execution tests (the ks vertical).
+
+Server side: ks rows compile loudly at admin time, mask shards lease
+smallest-keyspace-first with an advancing coverage frontier, releases
+retire coverage under the (hkey, epoch) key, and reaped ranges re-issue
+without double-credit.  Client side: a mask unit cracks a planted
+in-keyspace PSK with ZERO dict bytes on the wire, and a mid-shard
+restart resumes bit-identically off the ``mask_done`` checkpoint.
+"""
+
+import io
+import json
+import urllib.parse
+
+import pytest
+
+from dwpa_tpu import testing as tfx
+from dwpa_tpu.client.main import ClientConfig, TpuCrackClient
+from dwpa_tpu.client.protocol import ServerAPI
+from dwpa_tpu.keyspace import KeyspaceError
+from dwpa_tpu.server import Database, ServerCore, make_wsgi_app
+from dwpa_tpu.server.jobs import maintenance
+
+PSK = b"wifipass77"   # index 77 of the 100-word ^wifipass\d{2}$ keyspace
+ESSID = b"MaskNet"
+
+
+@pytest.fixture
+def core(tmp_path):
+    c = ServerCore(Database(":memory:"), dictdir=str(tmp_path / "dicts"),
+                   capdir=str(tmp_path / "caps"))
+    c.mask_shard_span = 40
+    return c
+
+
+def _plant(core, seed="ms1", psk=PSK):
+    core.add_hashlines([tfx.make_pmkid_line(psk, ESSID, seed=seed)])
+    core.db.x("UPDATE nets SET algo = ''")
+
+
+def _masks(work):
+    return [(m["mask"], m["skip"], m["limit"]) for m in work["masks"]]
+
+
+# ---------------------------------------------------------------------------
+# admin surface
+# ---------------------------------------------------------------------------
+
+
+def test_ks_add_rejects_loudly_and_inserts_nothing(core):
+    with pytest.raises(KeyspaceError):
+        core.ks_add(r"^Net$", r"free.*")        # uncompilable pass side
+    import re
+    with pytest.raises(re.error):
+        core.ks_add(r"([", r"^pw\d{2}$")        # broken ssid side
+    assert core.ks_rows(enabled_only=False) == []
+    kid = core.ks_add(r"^Net$", r"^pw\d{2}$", priority=7)
+    rows = core.ks_rows()
+    assert [r["ks_id"] for r in rows] == [kid]
+    assert rows[0]["priority"] == 7 and rows[0]["enabled"] == 1
+
+
+# ---------------------------------------------------------------------------
+# scheduling: frontier, ordering, coverage
+# ---------------------------------------------------------------------------
+
+
+def test_mask_shards_issue_smallest_first_with_advancing_frontier(core):
+    _plant(core)
+    core.ks_add(r"^MaskNet$", r"^wifipass\d{2}$|^[ab]pw-pass$")
+    w1 = core.get_work(1)
+    # smallest keyspace first: the 2-word [ab] branch leads
+    assert _masks(w1) == [("?1pw-pass", 0, 2)]
+    assert w1["dicts"] == []
+    assert w1["masks"][0]["custom"] == {"1": "ab"}
+    w2 = core.get_work(1)
+    assert _masks(w2) == [("wifipass?d?d", 0, 40)]
+    w3 = core.get_work(2)   # budget 2: the two remaining shards
+    assert _masks(w3) == [("wifipass?d?d", 40, 40), ("wifipass?d?d", 80, 20)]
+    assert core.get_work(4) is None   # keyspace fully in flight
+    # releases retire coverage: hkey NULL, spans intact
+    for w in (w1, w2, w3):
+        core.put_work({"hkey": w["hkey"], "epoch": w.get("epoch"),
+                       "type": "bssid", "cand": []})
+    rows = core.db.q("SELECT skip, span, hkey FROM n2m ORDER BY skip, span")
+    assert all(r["hkey"] is None for r in rows)
+    assert sum(r["span"] for r in rows) == 102
+    assert core.get_work(4) is None   # fully covered, nothing re-issues
+
+
+def test_mask_shards_ride_along_with_dicts(core, tmp_path):
+    import gzip
+    import hashlib
+    import os
+
+    _plant(core)
+    core.ks_add(r"^MaskNet$", r"^wifipass\d{2}$")
+    os.makedirs(core.dictdir, exist_ok=True)
+    blob = gzip.compress(b"not-the-psk\n")
+    with open(os.path.join(core.dictdir, "one.txt.gz"), "wb") as f:
+        f.write(blob)
+    core.add_dict("dict/one.txt.gz", "one.txt.gz",
+                  hashlib.md5(blob).hexdigest(), 1, rules=None)
+    w = core.get_work(2)
+    # budget 2 = 1 dict + 1 mask shard in the same unit
+    assert len(w["dicts"]) == 1
+    assert _masks(w) == [("wifipass?d?d", 0, 40)]
+
+
+def test_keyspace_gauges_track_total_and_done(core, tmp_path):
+    from dwpa_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    core = ServerCore(Database(":memory:"), dictdir=str(tmp_path / "d2"),
+                      capdir=str(tmp_path / "c2"), registry=reg)
+    core.mask_shard_span = 40
+    _plant(core)
+    core.ks_add(r"^MaskNet$", r"^wifipass\d{2}$")
+    core.observe_metrics()
+    text = reg.render_prometheus()
+    assert "dwpa_keyspace_mask_total 100" in text
+    assert "dwpa_keyspace_mask_done 0" in text
+    w = core.get_work(1)
+    core.put_work({"hkey": w["hkey"], "epoch": w.get("epoch"),
+                   "type": "bssid", "cand": []})
+    core.observe_metrics()
+    text = reg.render_prometheus()
+    assert "dwpa_keyspace_mask_total 100" in text
+    assert "dwpa_keyspace_mask_done 40" in text
+
+
+def test_reaped_ranges_reissue_without_double_credit(core):
+    _plant(core)
+    core.ks_add(r"^MaskNet$", r"^wifipass\d{2}$")
+    w1 = core.get_work(1)
+    assert _masks(w1) == [("wifipass?d?d", 0, 40)]
+    # abandon the unit: age the lease + its coverage past the window
+    core.db.x("UPDATE n2m SET ts = ts - 4 * 3600 WHERE hkey = ?",
+              (w1["hkey"],))
+    core.db.x("UPDATE leases SET issued = issued - 4 * 3600 WHERE hkey = ?",
+              (w1["hkey"],))
+    maintenance(core)
+    # reap DELETEs (a NULLed row would count as completed coverage):
+    # the abandoned range reopens as a gap
+    assert core.db.q1("SELECT COUNT(*) c FROM n2m")["c"] == 0
+    # maintenance materialized the cracked-psk feedback dict, so budget
+    # 2 = that dict + the re-issued shard riding along
+    w2 = core.get_work(2)
+    assert _masks(w2) == [("wifipass?d?d", 0, 40)]   # same range, re-issued
+    assert w2["hkey"] != w1["hkey"]
+    # the stale holder's keyed release matches no live lease: no credit
+    core.put_work({"hkey": w1["hkey"], "epoch": w1.get("epoch"),
+                   "type": "bssid", "cand": []})
+    assert core.db.q1(
+        "SELECT COALESCE(SUM(span), 0) c FROM n2m WHERE hkey IS NULL"
+    )["c"] == 0
+    # the live holder's release credits the range exactly once
+    core.put_work({"hkey": w2["hkey"], "epoch": w2.get("epoch"),
+                   "type": "bssid", "cand": []})
+    assert core.db.q1(
+        "SELECT COALESCE(SUM(span), 0) c FROM n2m WHERE hkey IS NULL"
+    )["c"] == 40
+
+
+def test_cracked_net_drops_its_mask_coverage(core):
+    from dwpa_tpu.models import hashline as hl
+
+    _plant(core)
+    core.ks_add(r"^MaskNet$", r"^wifipass\d{2}$")
+    w = core.get_work(1)
+    mac = hl.parse(w["hashes"][0]).mac_ap.hex()
+    core.put_work({"hkey": w["hkey"], "epoch": w.get("epoch"),
+                   "type": "bssid", "cand": [{"k": mac, "v": PSK.hex()}]})
+    assert core.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+    assert core.db.q1("SELECT COUNT(*) c FROM n2m")["c"] == 0
+    assert core.get_work(4) is None
+
+
+# ---------------------------------------------------------------------------
+# loopback execution: zero dict bytes, exact resume
+# ---------------------------------------------------------------------------
+
+
+class LoopbackAPI(ServerAPI):
+    """ServerAPI whose transport is a direct WSGI call (no sockets)."""
+
+    def __init__(self, app, **kw):
+        kw.setdefault("max_tries", 1)
+        kw.setdefault("sleep", lambda s: None)
+        super().__init__("http://loopback/", **kw)
+        self.app = app
+        self.requests = []
+
+    def fetch(self, url, data=None, max_tries=None):
+        parsed = urllib.parse.urlparse(url)
+        body = json.dumps(data).encode() if data is not None else b""
+        environ = {
+            "REQUEST_METHOD": "POST" if data is not None else "GET",
+            "PATH_INFO": parsed.path or "/",
+            "QUERY_STRING": parsed.query,
+            "CONTENT_LENGTH": str(len(body)),
+            "wsgi.input": io.BytesIO(body),
+            "REMOTE_ADDR": "127.0.0.1",
+        }
+        out = {}
+
+        def start_response(status, headers):
+            out["status"] = status
+
+        resp = b"".join(self.app(environ, start_response))
+        self.requests.append((environ["REQUEST_METHOD"], url, len(resp)))
+        if not out["status"].startswith("200"):
+            raise ConnectionError(f"{url}: {out['status']}")
+        return resp
+
+
+def _client(core, tmp_path, **cfg_kw):
+    cfg_kw.setdefault("batch_size", 64)
+    cfg_kw.setdefault("dictcount", 1)
+    cfg_kw.setdefault("device_streams", "off")
+    cfg = ClientConfig(base_url="http://loopback/",
+                       workdir=str(tmp_path / "work"), **cfg_kw)
+    api = LoopbackAPI(make_wsgi_app(core))
+    return TpuCrackClient(cfg, api=api, log=lambda *a, **k: None)
+
+
+def _mask_core(tmp_path, span=200, psk=PSK):
+    core = ServerCore(Database(":memory:"), dictdir=str(tmp_path / "dicts"),
+                      capdir=str(tmp_path / "caps"))
+    core.mask_shard_span = span
+    _plant(core, seed="lb1", psk=psk)
+    core.ks_add(r"^MaskNet$", r"^wifipass\d{2}$")
+    return core
+
+
+def test_mask_unit_cracks_planted_psk_with_zero_dict_bytes(tmp_path):
+    core = _mask_core(tmp_path)
+    client = _client(core, tmp_path)
+    work = client.api.get_work(1)
+    assert work["dicts"] == [] and _masks(work) == [("wifipass?d?d", 0, 100)]
+    res = client.process_work(work)
+    assert res.accepted and [f.psk for f in res.founds] == [PSK]
+    assert core.db.q1("SELECT n_state, pass FROM nets")["pass"] == PSK
+    # zero candidate bytes on the wire: no dict endpoint was ever hit
+    assert [u for m, u, n in client.api.requests if "dict" in u] == []
+    # the unit's coverage retired with the crack
+    assert core.db.q1("SELECT COUNT(*) c FROM n2m")["c"] == 0
+
+
+def test_mask_checkpoint_counts_keyspace_coordinates(tmp_path):
+    """``mask_done`` advances in exact keyspace indices (block counts,
+    not padded batch widths) — the coordinate the -s/-l resume relies
+    on."""
+    core = _mask_core(tmp_path)
+    client = _client(core, tmp_path)
+    snaps = []
+    real = client._write_resume
+    client._write_resume = lambda w: (
+        snaps.append(json.loads(json.dumps(w.get("_progress")))), real(w))[1]
+    work = client.api.get_work(1)
+    res = client.process_work(work)
+    assert res.accepted
+    dones = [s["mask_done"] for s in snaps if s]
+    assert dones == sorted(dones) and dones[-1] == 100
+    assert 64 in dones   # the first 64-wide block checkpointed mid-shard
+
+
+def test_mid_shard_restart_resumes_bit_identical(tmp_path):
+    """Kill after the first mask batch: the revived client replays
+    EXACTLY the uncovered suffix (no candidate re-tried, none skipped)
+    and still finds the planted PSK sitting past the checkpoint."""
+    core = _mask_core(tmp_path)
+    crashed = _client(core, tmp_path)
+    work = crashed.api.get_work(1)
+    # simulated crash after one 64-wide batch: the checkpoint the client
+    # would have written (dict passes fully done, mask shard at 64)
+    work["_progress"] = {"done": 10 ** 6, "mask_done": 64, "cand": []}
+    crashed._write_resume(work)
+
+    revived = _client(core, tmp_path)
+    replayed = revived._read_resume()
+    assert replayed == work
+    res = revived.process_work(replayed)
+    assert res.accepted
+    # bit-identical suffix: exactly keyspace - checkpoint candidates
+    assert res.candidates_tried == 100 - 64
+    assert [f.psk for f in res.founds] == [PSK]   # index 77 >= 64
+    assert core.db.q1("SELECT n_state FROM nets")["n_state"] == 1
+
+
+def test_restart_fast_forwards_whole_finished_shards(tmp_path):
+    """A unit carrying several shards resumes past fully-done shards via
+    the cumulative mask_done counter and mid-resumes the next one."""
+    # psk in the LAST shard, so no shard short-circuits on an early
+    # crack and the replayed suffix is exactly the uncovered keyspace
+    core = _mask_core(tmp_path, span=40, psk=b"wifipass92")
+    client = _client(core, tmp_path, dictcount=3)
+    work = client.api.get_work(3)
+    assert _masks(work) == [("wifipass?d?d", 0, 40), ("wifipass?d?d", 40, 40),
+                            ("wifipass?d?d", 80, 20)]
+    # crash at cumulative 50: shard 1 done, shard 2 at offset 10
+    work["_progress"] = {"done": 10 ** 6, "mask_done": 50, "cand": []}
+    res = client.process_work(work)
+    assert res.accepted
+    assert res.candidates_tried == 100 - 50
+    assert [f.psk for f in res.founds] == [b"wifipass92"]
